@@ -1,0 +1,35 @@
+"""kcp_tpu — a TPU-native multi-tenant control-plane framework.
+
+A brand-new implementation of the capabilities of the kcp prototype
+(reference: /root/reference, sttts/kcp @ Oct 2021): a minimal
+Kubernetes-style API server serving many cheap *logical clusters* from one
+store, schema import and lowest-common-denominator negotiation of CRDs,
+label-driven spec<->status syncers, and a multi-cluster workload splitter.
+
+Instead of one goroutine per workspace (reference:
+pkg/reconciler/cluster/controller.go:243-263), the per-tenant reconcile
+loops are vectorized as batched JAX programs (vmap/pjit/Pallas) behind a
+swappable reconciler backend:
+
+- host side (Python/asyncio): API surface, storage, watches, schema trees
+- device side (JAX): object diffing, patch-set decisions, replica
+  placement, label-selector fan-out, schema hashing
+
+Layout:
+- ``kcp_tpu.store``        logical-cluster keyspace + watch hub (etcd analog)
+- ``kcp_tpu.apis``         API types: Cluster, APIResourceImport, ...
+- ``kcp_tpu.client``       clients, informers, listers (pkg/client analog)
+- ``kcp_tpu.reconciler``   controller runtime, batched workqueue, backends
+- ``kcp_tpu.ops``          device kernels: encode/diff/placement/labelmatch
+- ``kcp_tpu.models``       the flagship fused reconcile-step program
+- ``kcp_tpu.parallel``     mesh/sharding over the tenant axis
+- ``kcp_tpu.syncer``       spec/status syncers (pkg/syncer analog)
+- ``kcp_tpu.schemacompat`` LCD schema negotiation (pkg/schemacompat analog)
+- ``kcp_tpu.crdpuller``    discovery -> CRD synthesis (pkg/crdpuller analog)
+- ``kcp_tpu.server``       minimal REST+watch API server (pkg/server analog)
+- ``kcp_tpu.reconcilers``  domain reconcilers (pkg/reconciler analog)
+- ``kcp_tpu.physical``     fake physical-cluster backend (kind analog)
+- ``kcp_tpu.cli``          CLI binaries (cmd/ analog)
+"""
+
+__version__ = "0.1.0"

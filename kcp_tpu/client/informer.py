@@ -1,0 +1,240 @@
+"""Informers: list+watch caches with indexers and event handlers.
+
+The analog of the reference's shared informer factories (generated in
+pkg/client/informers/**, used by every controller). Differences, by
+design:
+
+- async tasks instead of goroutines
+- handlers receive (event_type, old, new) and are called on the event
+  loop; controllers usually just enqueue keys — the heavy lifting happens
+  in the batched reconcile tick
+- a periodic resync replays the full cache as MODIFIED events, the
+  level-triggered safety net that bounds missed-event damage
+  (reference resyncPeriod=10h, pkg/syncer/syncer.go:27)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Awaitable, Callable, Iterable
+
+from ..apis.scheme import GVR
+from ..store.selectors import LabelSelector
+from ..store.store import ADDED, DELETED, MODIFIED, Event
+from .client import Client
+
+log = logging.getLogger(__name__)
+
+Handler = Callable[[str, dict | None, dict | None], None]
+IndexFunc = Callable[[dict], Iterable[str]]
+
+# Standard indexers, mirroring the reference's
+# (pkg/reconciler/cluster/controller.go:50-60, 134-149).
+def by_cluster(obj: dict) -> list[str]:
+    return [obj["metadata"].get("clusterName", "")]
+
+
+def by_namespace(obj: dict) -> list[str]:
+    return [obj["metadata"].get("namespace", "")]
+
+
+def by_location(obj: dict) -> list[str]:
+    """APIResourceImport spec.location indexer (LocationInLogicalCluster)."""
+    return [f'{obj["metadata"].get("clusterName", "")}/{obj.get("spec", {}).get("location", "")}']
+
+
+def by_location_and_gvr(obj: dict) -> list[str]:
+    """GVRForLocationInLogicalCluster analog."""
+    spec = obj.get("spec", {})
+    gv = spec.get("groupVersion", {})
+    gvr = f'{gv.get("group", "")}/{gv.get("version", "")}/{spec.get("plural", "")}'
+    return [
+        f'{obj["metadata"].get("clusterName", "")}/{spec.get("location", "")}/{gvr}'
+    ]
+
+
+class Informer:
+    """A list+watch cache for one GVR (optionally selector/namespace bound)."""
+
+    def __init__(
+        self,
+        client: Client,
+        gvr: GVR | str,
+        selector: LabelSelector | None = None,
+        namespace: str | None = None,
+        resync_period: float | None = None,
+    ):
+        self.client = client
+        self.gvr = gvr
+        self.selector = selector
+        self.namespace = namespace
+        self.resync_period = resync_period
+        self.cache: dict[tuple[str, str, str], dict] = {}  # (cluster, ns, name) -> obj
+        self._handlers: list[Handler] = []
+        self._indexers: dict[str, IndexFunc] = {}
+        self._indices: dict[str, dict[str, set[tuple[str, str, str]]]] = {}
+        self._synced = asyncio.Event()
+        self._task: asyncio.Task | None = None
+        self._resync_task: asyncio.Task | None = None
+        self._watch = None
+
+    # ------------------------------------------------------------ wiring
+
+    def add_handler(self, handler: Handler) -> None:
+        self._handlers.append(handler)
+        # late subscribers see the existing cache as adds, as in client-go
+        for obj in list(self.cache.values()):
+            try:
+                handler(ADDED, None, obj)
+            except Exception:  # noqa: BLE001
+                log.exception("informer %s: handler failed on replay", self.gvr)
+
+    def add_indexer(self, name: str, fn: IndexFunc) -> None:
+        self._indexers[name] = fn
+        self._indices[name] = {}
+        for key, obj in self.cache.items():
+            self._index_insert(name, key, obj)
+
+    def index(self, name: str, value: str) -> list[dict]:
+        keys = self._indices.get(name, {}).get(value, set())
+        return [self.cache[k] for k in keys if k in self.cache]
+
+    # ------------------------------------------------------------- cache
+
+    @staticmethod
+    def _key(obj: dict) -> tuple[str, str, str]:
+        m = obj["metadata"]
+        return (m.get("clusterName", ""), m.get("namespace", ""), m["name"])
+
+    def get(self, cluster: str, name: str, namespace: str = "") -> dict | None:
+        return self.cache.get((cluster, namespace, name))
+
+    def list(self) -> list[dict]:
+        return list(self.cache.values())
+
+    def _index_insert(self, iname: str, key, obj) -> None:
+        for v in self._indexers[iname](obj):
+            self._indices[iname].setdefault(v, set()).add(key)
+
+    def _index_remove(self, iname: str, key, obj) -> None:
+        for v in self._indexers[iname](obj):
+            s = self._indices[iname].get(v)
+            if s:
+                s.discard(key)
+
+    def _apply(self, etype: str, obj: dict) -> None:
+        key = self._key(obj)
+        old = self.cache.get(key)
+        if etype == DELETED:
+            if old is not None:
+                del self.cache[key]
+                for iname in self._indexers:
+                    self._index_remove(iname, key, old)
+            new = None
+        else:
+            self.cache[key] = obj
+            for iname in self._indexers:
+                if old is not None:
+                    self._index_remove(iname, key, old)
+                self._index_insert(iname, key, obj)
+            new = obj
+        self._notify(etype, old, new)
+
+    def _notify(self, etype: str, old: dict | None, new: dict | None) -> None:
+        # a throwing handler must not kill the pump task (and with it all
+        # cache updates for every consumer of this informer)
+        for h in self._handlers:
+            try:
+                h(etype, old, new)
+            except Exception:  # noqa: BLE001
+                log.exception("informer %s: handler failed on %s event", self.gvr, etype)
+
+    # --------------------------------------------------------------- run
+
+    async def start(self) -> None:
+        """List, populate, open the watch, and start the pump task."""
+        items, rv = self.client.list(self.gvr, self.namespace, self.selector)
+        for obj in items:
+            self._apply(ADDED, obj)
+        self._watch = self.client.watch(
+            self.gvr, self.namespace, self.selector, since_rv=rv
+        )
+        self._synced.set()
+        self._task = asyncio.create_task(self._pump())
+        if self.resync_period:
+            self._resync_task = asyncio.create_task(self._resync_loop())
+
+    async def _pump(self) -> None:
+        assert self._watch is not None
+        async for ev in self._watch:
+            self._dispatch(ev)
+
+    def _dispatch(self, ev: Event) -> None:
+        self._apply(ev.type, ev.object)
+
+    async def _resync_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.resync_period)
+            self.resync()
+
+    def resync(self) -> None:
+        """Replay the cache as MODIFIED events (level-triggered safety net)."""
+        for obj in list(self.cache.values()):
+            self._notify(MODIFIED, obj, obj)
+
+    async def wait_synced(self) -> None:
+        await self._synced.wait()
+
+    @property
+    def synced(self) -> bool:
+        return self._synced.is_set()
+
+    async def stop(self) -> None:
+        for t in (self._task, self._resync_task):
+            if t is not None:
+                t.cancel()
+                try:
+                    await t
+                except asyncio.CancelledError:
+                    pass
+        self._task = self._resync_task = None
+        if self._watch is not None:
+            self._watch.close()
+            self._watch = None
+
+
+class SharedInformerFactory:
+    """One informer per GVR, shared across controllers.
+
+    The analog of the reference's externalversions.SharedInformerFactory
+    (generated; used at pkg/server/server.go:231-250).
+    """
+
+    def __init__(self, client: Client, resync_period: float | None = None):
+        self.client = client
+        self.resync_period = resync_period
+        self._informers: dict[str, Informer] = {}
+
+    def informer(self, gvr: GVR | str, selector: LabelSelector | None = None) -> Informer:
+        key = str(gvr) + ("|" + str(selector) if selector and not selector.empty else "")
+        if key not in self._informers:
+            self._informers[key] = Informer(
+                self.client, gvr, selector, resync_period=self.resync_period
+            )
+        return self._informers[key]
+
+    async def start(self) -> None:
+        await asyncio.gather(
+            *(i.start() for i in self._informers.values() if not i.synced)
+        )
+
+    async def stop(self) -> None:
+        await asyncio.gather(*(i.stop() for i in self._informers.values()))
+
+
+async def run_informers(*informers: Informer) -> None:
+    await asyncio.gather(*(i.start() for i in informers))
+
+
+HandlerCoro = Callable[[str, dict | None, dict | None], Awaitable[None]]

@@ -1,0 +1,29 @@
+"""Device-side decision math.
+
+Everything in this package is regular, batched, fixed-shape tensor code —
+the vectorized re-expression of the reference's per-goroutine hot loops
+(SURVEY.md §2.4 table):
+
+- ``encode``     objects -> fixed-shape hash tensors, schema bucketing
+- ``diff``       batched spec/status three-way diff (pkg/syncer analog)
+- ``placement``  replica bin-packing + status fan-in (deployment splitter)
+- ``labelmatch`` label-selector match fan-out (informer filtering)
+- ``schemahash`` batched schema hashing for bucket assignment
+- ``hashing``    host-side FNV-1a primitives feeding the encoders
+"""
+
+from .diff import DECISION_CREATE, DECISION_DELETE, DECISION_NOOP, DECISION_UPDATE, sync_decisions
+from .encode import BucketEncoder, EncodedBatch
+from .placement import aggregate_status, split_replicas
+
+__all__ = [
+    "BucketEncoder",
+    "EncodedBatch",
+    "sync_decisions",
+    "split_replicas",
+    "aggregate_status",
+    "DECISION_NOOP",
+    "DECISION_CREATE",
+    "DECISION_UPDATE",
+    "DECISION_DELETE",
+]

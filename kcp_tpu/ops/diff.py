@@ -1,0 +1,94 @@
+"""Batched spec/status three-way diff — the syncer hot loop, vectorized.
+
+The reference runs ``deepEqualApartFromStatus`` / ``deepEqualStatus`` on
+every informer event in per-cluster goroutines (pkg/syncer/
+specsyncer.go:17-41, statussyncer.go:15-27) and then decides per object:
+create downstream, update downstream, delete downstream, or upsync status
+(specsyncer.go:86-132, statussyncer.go:41-63).
+
+Here the same decision runs once, vectorized over every object of every
+logical cluster in a schema bucket: one fused XLA program of elementwise
+compares + masked reductions (pure VPU work, HBM-bandwidth bound, which is
+exactly what a TPU does well at 100k+ rows).
+
+Decision codes (uint8):
+    0 NOOP    — in sync (or neither side exists)
+    1 CREATE  — upstream exists, downstream missing -> create downstream
+    2 UPDATE  — both exist, spec lanes differ       -> update downstream
+    3 DELETE  — upstream gone, downstream exists    -> delete downstream
+
+``status_upsync`` is an independent lane (both exist and status differs ->
+copy status upstream), matching the reference's two separate controllers.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+DECISION_NOOP = 0
+DECISION_CREATE = 1
+DECISION_UPDATE = 2
+DECISION_DELETE = 3
+
+
+class SyncDecisions(NamedTuple):
+    decision: jax.Array  # uint8 [B]
+    status_upsync: jax.Array  # bool [B]
+    changed_slots: jax.Array  # bool [B, S] (valid where both sides exist)
+
+
+def sync_decisions(
+    up_vals: jax.Array,  # uint32 [B, S] upstream encodings
+    up_exists: jax.Array,  # bool  [B]
+    down_vals: jax.Array,  # uint32 [B, S] downstream encodings
+    down_exists: jax.Array,  # bool [B]
+    status_mask: jax.Array,  # bool [S] True for status.* slots
+) -> SyncDecisions:
+    neq = up_vals != down_vals  # [B, S]
+    spec_dirty = (neq & ~status_mask[None, :]).any(axis=-1)
+    status_dirty = (neq & status_mask[None, :]).any(axis=-1)
+
+    both = up_exists & down_exists
+    decision = jnp.where(
+        up_exists & ~down_exists,
+        jnp.uint8(DECISION_CREATE),
+        jnp.where(
+            ~up_exists & down_exists,
+            jnp.uint8(DECISION_DELETE),
+            jnp.where(both & spec_dirty, jnp.uint8(DECISION_UPDATE), jnp.uint8(DECISION_NOOP)),
+        ),
+    )
+    return SyncDecisions(decision, both & status_dirty, neq)
+
+
+sync_decisions_jit = jax.jit(sync_decisions)
+
+
+def apply_deltas(
+    vals: jax.Array,  # uint32 [B, S] device-resident mirror
+    exists: jax.Array,  # bool  [B]
+    idx: jax.Array,  # int32 [D] rows touched by this delta batch
+    new_vals: jax.Array,  # uint32 [D, S] new encodings (ignored for deletes)
+    new_exists: jax.Array,  # bool [D] False = delete
+    valid: jax.Array,  # bool [D] padding mask for the delta batch
+) -> tuple[jax.Array, jax.Array]:
+    """Scatter a padded delta batch into the device-resident mirror.
+
+    This is the informer-cache-update analog: instead of a Go indexer
+    mutation per event, the reconcile tick scatters the whole drained
+    event batch in one compiled op. Padding rows are routed out of bounds
+    and dropped by the scatter. The host batcher must dedup deltas by key
+    (last event wins) before building the batch — duplicate in-batch
+    indices have unspecified scatter order.
+    """
+    oob = jnp.int32(vals.shape[0])
+    idx = jnp.where(valid, idx, oob)
+    vals = vals.at[idx].set(new_vals, mode="drop")
+    exists = exists.at[idx].set(new_exists, mode="drop")
+    return vals, exists
+
+
+apply_deltas_jit = jax.jit(apply_deltas)

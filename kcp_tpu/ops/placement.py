@@ -1,0 +1,60 @@
+"""Batched replica placement + status fan-in — the deployment splitter math.
+
+The reference splits a root Deployment's replicas evenly across registered
+clusters, remainder to the first clusters, one root at a time in a
+goroutine (pkg/reconciler/deployment/deployment.go:125-161), and
+aggregates leaf status counters back into the root (deployment.go:71-91).
+
+Here both run batched over every (workspace, root-deployment) pair at
+once: B roots x P physical clusters. This is BASELINE.json configs[2]
+(10k workspaces x 8 clusters) expressed as a few hundred fused VPU ops.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def split_replicas(replicas: jax.Array, avail: jax.Array) -> jax.Array:
+    """Even split with remainder to the first available clusters.
+
+    replicas: int32 [B]   desired root replicas
+    avail:    bool  [B,P] cluster availability (Ready and not excluded)
+    returns:  int32 [B,P] leaf replica counts (0 where unavailable)
+
+    Parity: floor division + remainder-to-first, matching
+    deployment.go:127-145 (``replicas/len(cls)`` then ``+1`` for the
+    first ``replicas%len(cls)`` leafs). With no available clusters the
+    row is all zeros (host sets Progressing=False, deployment.go:110-123).
+    """
+    avail_i = avail.astype(jnp.int32)
+    n = avail_i.sum(axis=-1, keepdims=True)  # [B,1]
+    n_safe = jnp.maximum(n, 1)
+    base = replicas[:, None] // n_safe
+    rem = replicas[:, None] - base * n_safe
+    # rank of each available cluster among available ones, in column order
+    rank = jnp.cumsum(avail_i, axis=-1) - 1
+    leaf = base + (rank < rem).astype(jnp.int32)
+    return jnp.where(avail & (n > 0), leaf, 0)
+
+
+def aggregate_status(leaf_counters: jax.Array, leaf_mask: jax.Array) -> jax.Array:
+    """Sum leaf status counters into root status counters.
+
+    leaf_counters: int32 [B,P,C] (e.g. C=5: replicas, updated, ready,
+                   available, unavailable — the five counters the
+                   reference sums, deployment.go:71-91)
+    leaf_mask:     bool  [B,P]   which leafs exist
+    returns:       int32 [B,C]
+    """
+    return (leaf_counters * leaf_mask[..., None].astype(leaf_counters.dtype)).sum(axis=1)
+
+
+def placement_changed(current: jax.Array, desired: jax.Array) -> jax.Array:
+    """bool [B]: any leaf's replica count differs -> row needs patching."""
+    return (current != desired).any(axis=-1)
+
+
+split_replicas_jit = jax.jit(split_replicas)
+aggregate_status_jit = jax.jit(aggregate_status)

@@ -1,0 +1,108 @@
+"""Batched schema hashing — bucket assignment for shape-homogeneous batches.
+
+The negotiation controller (pkg/reconciler/apiresource) compares imported
+schemas across thousands of tenants. The tree-walk LCD computation stays
+host-side (kcp_tpu/schemacompat — irregular recursion), but the *bucketing*
+decision ("which imports share a schema and can be batch-processed / which
+negotiated schema does an import already match") reduces to hashing the
+canonical token stream of each schema — BASELINE.json configs[3], 5k
+tenant CRD sets.
+
+Device computation: a polynomial rolling hash over fixed-length uint32
+token vectors
+
+    h = mix( sum_i tokens[i] * P^(T-1-i)  mod 2^32 )
+
+The power-weighted sum is a plain dot product -> batches of thousands of
+schemas hash as one [B, T] x [T] matmul-shaped reduction on the MXU/VPU,
+with a murmur finalizer for avalanche.
+
+Host-side :func:`tokenize_schema` produces the canonical token stream
+(sorted keys, type tags), so equal schemas tokenize equally regardless of
+dict ordering.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .hashing import hash_str, hash_value
+
+POLY = np.uint32(0x01000193)  # FNV prime as the polynomial base
+
+
+def tokenize_schema(schema: dict, max_tokens: int = 256) -> np.ndarray:
+    """Canonical uint32 token stream of a JSON-schema subtree.
+
+    Deterministic: dict keys sorted; every structural element contributes
+    (key-hash, value-token) pairs; nested dicts/lists recurse with
+    open/close markers so different nestings cannot collide structurally.
+    Overflow truncates (the trailing tokens still contribute via length
+    token) — an acceptable, bounded collision source, and the LCD engine
+    re-checks equality host-side before trusting a bucket hit.
+    """
+    toks: list[int] = []
+
+    OPEN, CLOSE, LIST_OPEN, LIST_CLOSE = 0xA11CE, 0xB0B, 0xC0DE, 0xD00D
+
+    def walk(v) -> None:
+        if len(toks) >= max_tokens:
+            return
+        if isinstance(v, dict):
+            toks.append(OPEN)
+            for k in sorted(v.keys()):
+                toks.append(hash_str(k))
+                walk(v[k])
+            toks.append(CLOSE)
+        elif isinstance(v, list):
+            toks.append(LIST_OPEN)
+            for item in v:
+                walk(item)
+            toks.append(LIST_CLOSE)
+        else:
+            toks.append(hash_value(v))
+
+    walk(schema)
+    toks.append(len(toks))  # length token guards truncation collisions
+    arr = np.zeros(max_tokens, dtype=np.uint32)
+    arr[: min(len(toks), max_tokens)] = np.array(toks[:max_tokens], dtype=np.uint64).astype(
+        np.uint32
+    )
+    return arr
+
+
+@lru_cache(maxsize=8)
+def _powers(t: int) -> np.ndarray:
+    out = np.ones(t, dtype=np.uint64)
+    for i in range(t - 2, -1, -1):
+        out[i] = (out[i + 1] * int(POLY)) & 0xFFFFFFFF
+    return out.astype(np.uint32)
+
+
+def schema_hashes(tokens: jax.Array) -> jax.Array:
+    """uint32 [B]: polynomial hash of each token row ([B, T])."""
+    t = tokens.shape[-1]
+    powers = jnp.asarray(_powers(t))
+    h = (tokens * powers[None, :]).sum(axis=-1, dtype=jnp.uint32)
+    # murmur3 finalizer
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    return h
+
+
+schema_hashes_jit = jax.jit(schema_hashes)
+
+
+def bucket_by_hash(hashes: np.ndarray) -> dict[int, np.ndarray]:
+    """Host-side: group row indices by hash value."""
+    out: dict[int, list[int]] = {}
+    for i, h in enumerate(np.asarray(hashes)):
+        out.setdefault(int(h), []).append(i)
+    return {h: np.array(idx, dtype=np.int32) for h, idx in out.items()}

@@ -1,0 +1,4 @@
+from .controller import Controller
+from .queue import WorkQueue
+
+__all__ = ["Controller", "WorkQueue"]

@@ -1,0 +1,158 @@
+"""Rate-limited work queue with batch drain.
+
+Behavioral parity with client-go's workqueue as the reference uses it
+(dedup while pending, per-item exponential backoff, 5 retries then drop —
+pkg/syncer/syncer.go:272-291, pkg/reconciler/cluster/controller.go:243-263)
+plus the one capability the TPU backend needs that client-go never had:
+:meth:`drain` — collect up to N ready items in one await, so a reconcile
+tick can process a whole batch in a single vectorized step instead of one
+goroutine wakeup per key.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import heapq
+import time
+from typing import Hashable
+
+Item = Hashable
+
+BASE_DELAY = 0.005  # client-go default rate limiter: 5ms * 2^n, capped
+MAX_DELAY = 1000.0
+
+
+class WorkQueue:
+    def __init__(self, name: str = "queue"):
+        self.name = name
+        self._ready: list[Item] = []
+        self._pending: set[Item] = set()  # dedup: queued or scheduled
+        self._processing: set[Item] = set()
+        self._redo: set[Item] = set()  # re-added while processing
+        self._delayed: list[tuple[float, int, Item]] = []  # heap
+        self._seq = 0
+        self._retries: dict[Item, int] = {}
+        self._wakeup: asyncio.Event = asyncio.Event()
+        self._shutdown = False
+
+    # ------------------------------------------------------------ adding
+
+    def add(self, item: Item) -> None:
+        if self._shutdown:
+            return
+        if item in self._processing:
+            self._redo.add(item)
+            return
+        if item in self._pending:
+            return
+        self._pending.add(item)
+        self._ready.append(item)
+        self._wakeup.set()
+
+    def add_after(self, item: Item, delay: float) -> None:
+        if self._shutdown:
+            return
+        if delay <= 0:
+            self.add(item)
+            return
+        if item in self._pending and item not in self._processing:
+            return
+        self._seq += 1
+        heapq.heappush(self._delayed, (time.monotonic() + delay, self._seq, item))
+        self._wakeup.set()
+
+    def add_rate_limited(self, item: Item) -> None:
+        """Requeue with exponential per-item backoff (5ms * 2^n, capped)."""
+        n = self._retries.get(item, 0)
+        self._retries[item] = n + 1
+        self.add_after(item, min(BASE_DELAY * (2**n), MAX_DELAY))
+
+    def num_requeues(self, item: Item) -> int:
+        return self._retries.get(item, 0)
+
+    def forget(self, item: Item) -> None:
+        self._retries.pop(item, None)
+
+    # ---------------------------------------------------------- consuming
+
+    def _promote_delayed(self) -> float | None:
+        """Move due delayed items to ready; return seconds until next due."""
+        now = time.monotonic()
+        while self._delayed and self._delayed[0][0] <= now:
+            _, _, item = heapq.heappop(self._delayed)
+            if item in self._processing:
+                self._redo.add(item)
+            elif item not in self._pending:
+                self._pending.add(item)
+                self._ready.append(item)
+        if self._delayed:
+            return max(0.0, self._delayed[0][0] - now)
+        return None
+
+    async def get(self) -> Item | None:
+        """Next item, or None on shutdown. Caller must call done(item)."""
+        while True:
+            next_due = self._promote_delayed()
+            if self._ready:
+                item = self._ready.pop(0)
+                self._pending.discard(item)
+                self._processing.add(item)
+                return item
+            if self._shutdown:
+                return None
+            self._wakeup.clear()
+            try:
+                await asyncio.wait_for(
+                    self._wakeup.wait(), timeout=next_due if next_due is not None else None
+                )
+            except asyncio.TimeoutError:
+                pass
+
+    async def drain(self, max_items: int = 1024, max_wait: float = 0.005) -> list[Item]:
+        """Batch get: await the first ready item, then keep collecting until
+        the queue momentarily empties or ``max_items`` is hit.
+
+        ``max_wait`` is the micro-batching window — the latency/batch-size
+        dial for p99 convergence (SURVEY.md §7.3).
+        """
+        first = await self.get()
+        if first is None:
+            return []
+        batch = [first]
+        deadline = time.monotonic() + max_wait
+        while len(batch) < max_items:
+            self._promote_delayed()
+            if self._ready:
+                item = self._ready.pop(0)
+                self._pending.discard(item)
+                self._processing.add(item)
+                batch.append(item)
+                continue
+            remaining = deadline - time.monotonic()
+            if remaining <= 0 or self._shutdown:
+                break
+            self._wakeup.clear()
+            try:
+                await asyncio.wait_for(self._wakeup.wait(), timeout=remaining)
+            except asyncio.TimeoutError:
+                break
+        return batch
+
+    def done(self, item: Item) -> None:
+        self._processing.discard(item)
+        if item in self._redo:
+            self._redo.discard(item)
+            self.add(item)
+
+    # ----------------------------------------------------------- control
+
+    def shut_down(self) -> None:
+        self._shutdown = True
+        self._wakeup.set()
+
+    def __len__(self) -> int:
+        return len(self._ready) + len(self._delayed)
+
+    @property
+    def shutting_down(self) -> bool:
+        return self._shutdown

@@ -1,0 +1,550 @@
+"""LogicalStore: the multi-tenant keyspace + watch hub.
+
+This is the storage layer of the framework — the analog of the reference's
+embedded etcd plus the forked apiserver's logical-cluster storage prefixing
+(reference: pkg/etcd/etcd.go; docs/investigations/logical-clusters.md:66-74,
+key scheme ``/<resource>/<cluster>/<namespace>/<name>``). It is deliberately
+also the test fake: the same object backs unit tests, the in-process API
+server, and the fake physical clusters.
+
+Semantics implemented (inferred from the reference's call sites, since the
+kcp-dev/kubernetes fork is not vendored there):
+
+- logical-cluster prefix keys; ``*`` (WILDCARD) lists/watches across all
+  tenants (logical-clusters.md:70-74)
+- a single monotonically increasing resourceVersion per store (etcd
+  revision analog); lists carry the store RV, watches can resume from an RV
+- optimistic concurrency: update with a stale metadata.resourceVersion
+  raises ConflictError
+- generation bumps on spec (non-status) changes only; status subresource
+  updates never bump generation
+- finalizers: delete sets deletionTimestamp first; object is removed when
+  the finalizer list is empty
+- label-selector filtered list/watch
+- optional durability via an append-only JSON-lines WAL with snapshot
+  compaction (restart resumes from durable storage, matching the
+  reference's restart-resumes-from-etcd model, server.go:80-97)
+
+Thread-model: single-threaded synchronous core intended to be called from
+one asyncio event loop; watches buffer into deques and optionally notify an
+asyncio.Event so async consumers can await new events.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import copy
+import json
+import os
+import time
+import uuid
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterator, Mapping
+
+from ..utils.errors import (
+    AlreadyExistsError,
+    ConflictError,
+    InvalidError,
+    NotFoundError,
+)
+from .selectors import LabelSelector, everything
+
+WILDCARD = "*"
+
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+
+Key = tuple[str, str, str, str]  # (resource, cluster, namespace, name)
+
+
+@dataclass(frozen=True)
+class Event:
+    type: str  # ADDED | MODIFIED | DELETED
+    resource: str
+    cluster: str
+    namespace: str
+    name: str
+    object: dict
+    rv: int
+    old_object: dict | None = None  # prior state on MODIFIED/DELETED
+
+    @property
+    def key(self) -> Key:
+        return (self.resource, self.cluster, self.namespace, self.name)
+
+
+class Watch:
+    """A filtered subscription to store events.
+
+    Sync consumers call :meth:`drain`; async consumers iterate with
+    ``async for``. Closing is idempotent.
+    """
+
+    def __init__(
+        self,
+        store: "LogicalStore",
+        resource: str,
+        cluster: str,
+        namespace: str | None,
+        selector: LabelSelector,
+    ):
+        self._store = store
+        self.resource = resource
+        self.cluster = cluster
+        self.namespace = namespace
+        self.selector = selector
+        self._events: deque[Event] = deque()
+        self._closed = False
+        self._wakeup: asyncio.Event | None = None
+
+    def _scope_match(self, ev: Event) -> bool:
+        if ev.resource != self.resource:
+            return False
+        if self.cluster != WILDCARD and ev.cluster != self.cluster:
+            return False
+        return self.namespace is None or ev.namespace == self.namespace
+
+    @staticmethod
+    def _labels(obj: dict | None) -> dict:
+        return ((obj or {}).get("metadata") or {}).get("labels") or {}
+
+    def _transform(self, ev: Event) -> Event | None:
+        """Filter/rewrite an event for this watch's selector.
+
+        Kubernetes apiserver semantics for selector-bound watches: an
+        object whose labels *stop* matching surfaces as DELETED (so caches
+        evict it), one whose labels *start* matching on an update surfaces
+        as ADDED. Without this, selector-bound informer caches go
+        permanently stale on label transitions.
+        """
+        if not self._scope_match(ev):
+            return None
+        if self.selector.empty:
+            return ev
+        new_match = ev.type != DELETED and self.selector.matches(self._labels(ev.object))
+        old_match = self.selector.matches(self._labels(ev.old_object))
+        if ev.type == ADDED:
+            return ev if new_match else None
+        if ev.type == DELETED:
+            return ev if old_match or new_match else None
+        if new_match and old_match:
+            return ev
+        if new_match:
+            return Event(ADDED, ev.resource, ev.cluster, ev.namespace, ev.name,
+                         ev.object, ev.rv, ev.old_object)
+        if old_match:
+            return Event(DELETED, ev.resource, ev.cluster, ev.namespace, ev.name,
+                         ev.object, ev.rv, ev.old_object)
+        return None
+
+    def _push(self, ev: Event) -> None:
+        if self._closed:
+            return
+        self._events.append(ev)
+        if self._wakeup is not None:
+            self._wakeup.set()
+
+    def drain(self) -> list[Event]:
+        """Return and clear all buffered events (sync consumers/tests)."""
+        out = list(self._events)
+        self._events.clear()
+        if self._wakeup is not None:
+            self._wakeup.clear()
+        return out
+
+    def pending(self) -> int:
+        return len(self._events)
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._store._unsubscribe(self)
+            if self._wakeup is not None:
+                self._wakeup.set()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def __aiter__(self) -> "Watch":
+        return self
+
+    async def __anext__(self) -> Event:
+        while True:
+            if self._events:
+                return self._events.popleft()
+            if self._closed:
+                raise StopAsyncIteration
+            if self._wakeup is None:
+                self._wakeup = asyncio.Event()
+            self._wakeup.clear()
+            await self._wakeup.wait()
+
+    async def next_batch(self, max_wait: float = 0.05) -> list[Event]:
+        """Await at least one event (or closure), then drain the buffer.
+
+        The batching primitive for the TPU backend: the reconcile tick
+        collects a delta batch instead of handling events one at a time.
+        """
+        if not self._events and not self._closed:
+            if self._wakeup is None:
+                self._wakeup = asyncio.Event()
+            self._wakeup.clear()
+            try:
+                await asyncio.wait_for(self._wakeup.wait(), timeout=max_wait)
+            except asyncio.TimeoutError:
+                pass
+        return self.drain()
+
+
+@dataclass
+class _WalConfig:
+    path: str
+    fh: Any = None
+    mutations_since_snapshot: int = 0
+    snapshot_every: int = 50_000
+
+
+class LogicalStore:
+    """The multi-tenant object store + watch hub."""
+
+    def __init__(self, wal_path: str | None = None, clock: Callable[[], float] = time.time):
+        self._objects: dict[Key, dict] = {}
+        self._rv = 0
+        self._watches: list[Watch] = []
+        self._history: deque[Event] = deque(maxlen=200_000)
+        self._clock = clock
+        self._wal: _WalConfig | None = None
+        if wal_path:
+            self._wal = _WalConfig(path=wal_path)
+            self._load_wal()
+            self._wal.fh = open(wal_path, "a", encoding="utf-8")
+
+    # ------------------------------------------------------------------ RV
+
+    @property
+    def resource_version(self) -> int:
+        return self._rv
+
+    def _next_rv(self) -> int:
+        self._rv += 1
+        return self._rv
+
+    # ------------------------------------------------------------- helpers
+
+    @staticmethod
+    def _key(resource: str, cluster: str, namespace: str, name: str) -> Key:
+        if not resource or not cluster or not name:
+            raise InvalidError("resource, cluster and name are required")
+        if cluster == WILDCARD:
+            raise InvalidError("wildcard cluster is read-only")
+        return (resource, cluster, namespace or "", name)
+
+    @staticmethod
+    def _meta(obj: Mapping) -> dict:
+        return obj.get("metadata") or {}
+
+    def _now(self) -> str:
+        return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(self._clock()))
+
+    # --------------------------------------------------------------- CRUD
+
+    def create(self, resource: str, cluster: str, obj: dict, namespace: str = "") -> dict:
+        obj = copy.deepcopy(obj)
+        meta = obj.setdefault("metadata", {})
+        name = meta.get("name")
+        if not name:
+            if meta.get("generateName"):
+                name = meta["generateName"] + uuid.uuid4().hex[:6]
+                meta["name"] = name
+            else:
+                raise InvalidError("metadata.name is required")
+        namespace = namespace or meta.get("namespace") or ""
+        key = self._key(resource, cluster, namespace, name)
+        if key in self._objects:
+            raise AlreadyExistsError(f"{resource} {cluster}/{namespace}/{name} already exists")
+        meta["namespace"] = namespace
+        meta["clusterName"] = cluster
+        meta["uid"] = meta.get("uid") or str(uuid.uuid4())
+        meta["creationTimestamp"] = self._now()
+        meta["generation"] = 1
+        rv = self._next_rv()
+        meta["resourceVersion"] = str(rv)
+        self._objects[key] = obj
+        self._emit(ADDED, key, obj, rv)
+        self._log_wal({"op": "put", "key": list(key), "obj": obj, "rv": rv})
+        return copy.deepcopy(obj)
+
+    def get(self, resource: str, cluster: str, name: str, namespace: str = "") -> dict:
+        key = self._key(resource, cluster, namespace, name)
+        obj = self._objects.get(key)
+        if obj is None:
+            raise NotFoundError(f"{resource} {cluster}/{namespace}/{name} not found")
+        return copy.deepcopy(obj)
+
+    def update(
+        self,
+        resource: str,
+        cluster: str,
+        obj: dict,
+        namespace: str = "",
+        subresource: str | None = None,
+    ) -> dict:
+        obj = copy.deepcopy(obj)
+        meta = self._meta(obj)
+        name = meta.get("name")
+        if not name:
+            raise InvalidError("metadata.name is required")
+        namespace = namespace or meta.get("namespace") or ""
+        key = self._key(resource, cluster, namespace, name)
+        existing = self._objects.get(key)
+        if existing is None:
+            raise NotFoundError(f"{resource} {cluster}/{namespace}/{name} not found")
+        ex_meta = existing["metadata"]
+        supplied_rv = meta.get("resourceVersion")
+        if supplied_rv and supplied_rv != ex_meta["resourceVersion"]:
+            raise ConflictError(
+                f"{resource} {cluster}/{namespace}/{name}: stale resourceVersion "
+                f"{supplied_rv} (current {ex_meta['resourceVersion']})"
+            )
+        if subresource == "status":
+            new_obj = copy.deepcopy(existing)
+            new_obj["status"] = obj.get("status")
+        else:
+            new_obj = obj
+            # status is only writable through the status subresource
+            if "status" in existing:
+                new_obj["status"] = copy.deepcopy(existing["status"])
+            elif "status" in new_obj:
+                del new_obj["status"]
+        new_meta = new_obj.setdefault("metadata", {})
+        if subresource != "status":
+            # metadata edits (labels/annotations/finalizers) ride spec updates
+            preserved = {
+                "uid": ex_meta.get("uid"),
+                "creationTimestamp": ex_meta.get("creationTimestamp"),
+                "clusterName": cluster,
+                "namespace": namespace,
+                "name": name,
+            }
+            new_meta.update(preserved)
+            if ex_meta.get("deletionTimestamp"):
+                new_meta["deletionTimestamp"] = ex_meta["deletionTimestamp"]
+        else:
+            new_obj["metadata"] = copy.deepcopy(ex_meta)
+            new_meta = new_obj["metadata"]
+
+        spec_changed = subresource != "status" and self._non_status_changed(existing, new_obj)
+        new_meta["generation"] = ex_meta.get("generation", 1) + (1 if spec_changed else 0)
+        rv = self._next_rv()
+        new_meta["resourceVersion"] = str(rv)
+        self._objects[key] = new_obj
+
+        # finalizer-driven deletion completion
+        if new_meta.get("deletionTimestamp") and not new_meta.get("finalizers"):
+            del self._objects[key]
+            self._emit(DELETED, key, new_obj, rv, old=existing)
+            self._log_wal({"op": "del", "key": list(key), "rv": rv})
+        else:
+            self._emit(MODIFIED, key, new_obj, rv, old=existing)
+            self._log_wal({"op": "put", "key": list(key), "obj": new_obj, "rv": rv})
+        return copy.deepcopy(new_obj)
+
+    def update_status(self, resource: str, cluster: str, obj: dict, namespace: str = "") -> dict:
+        return self.update(resource, cluster, obj, namespace, subresource="status")
+
+    def delete(self, resource: str, cluster: str, name: str, namespace: str = "") -> None:
+        key = self._key(resource, cluster, namespace, name)
+        existing = self._objects.get(key)
+        if existing is None:
+            raise NotFoundError(f"{resource} {cluster}/{namespace}/{name} not found")
+        meta = existing["metadata"]
+        if meta.get("finalizers"):
+            if not meta.get("deletionTimestamp"):
+                obj = copy.deepcopy(existing)
+                obj["metadata"]["deletionTimestamp"] = self._now()
+                rv = self._next_rv()
+                obj["metadata"]["resourceVersion"] = str(rv)
+                self._objects[key] = obj
+                self._emit(MODIFIED, key, obj, rv, old=existing)
+                self._log_wal({"op": "put", "key": list(key), "obj": obj, "rv": rv})
+            return
+        del self._objects[key]
+        rv = self._next_rv()
+        self._emit(DELETED, key, existing, rv, old=existing)
+        self._log_wal({"op": "del", "key": list(key), "rv": rv})
+
+    # --------------------------------------------------------------- list
+
+    def list(
+        self,
+        resource: str,
+        cluster: str = WILDCARD,
+        namespace: str | None = None,
+        selector: LabelSelector | None = None,
+    ) -> tuple[list[dict], int]:
+        """Return (items, list resourceVersion)."""
+        selector = selector or everything()
+        out = []
+        for (res, cl, ns, _name), obj in self._objects.items():
+            if res != resource:
+                continue
+            if cluster != WILDCARD and cl != cluster:
+                continue
+            if namespace is not None and ns != namespace:
+                continue
+            labels = (obj.get("metadata") or {}).get("labels") or {}
+            if not selector.matches(labels):
+                continue
+            out.append(copy.deepcopy(obj))
+        out.sort(key=lambda o: (o["metadata"].get("clusterName", ""),
+                                o["metadata"].get("namespace", ""),
+                                o["metadata"]["name"]))
+        return out, self._rv
+
+    def resources(self) -> list[str]:
+        """Distinct resource names present in the store."""
+        return sorted({k[0] for k in self._objects})
+
+    def clusters(self) -> list[str]:
+        """Distinct logical-cluster names present in the store."""
+        return sorted({k[1] for k in self._objects})
+
+    def __len__(self) -> int:
+        return len(self._objects)
+
+    # -------------------------------------------------------------- watch
+
+    def watch(
+        self,
+        resource: str,
+        cluster: str = WILDCARD,
+        namespace: str | None = None,
+        selector: LabelSelector | None = None,
+        since_rv: int | None = None,
+    ) -> Watch:
+        """Subscribe. With ``since_rv``, replays retained history > since_rv."""
+        w = Watch(self, resource, cluster, namespace, selector or everything())
+        if since_rv is not None and since_rv < self._rv:
+            # the retained history must cover (since_rv, now]; otherwise the
+            # caller missed events it can never recover (e.g. resuming a
+            # pre-restart RV against a WAL-restored store) and must re-list
+            oldest = self._history[0].rv if self._history else None
+            if oldest is None or oldest > since_rv + 1:
+                raise ConflictError(
+                    f"watch window expired: requested rv {since_rv}, oldest retained {oldest}"
+                )
+            for ev in self._history:
+                if ev.rv > since_rv:
+                    out = w._transform(ev)
+                    if out is not None:
+                        w._push(out)
+        self._watches.append(w)
+        return w
+
+    def _emit(self, etype: str, key: Key, obj: dict, rv: int, old: dict | None = None) -> None:
+        ev = Event(
+            etype, key[0], key[1], key[2], key[3], copy.deepcopy(obj), rv,
+            copy.deepcopy(old) if old is not None else None,
+        )
+        self._history.append(ev)
+        for w in self._watches:
+            out = w._transform(ev)
+            if out is not None:
+                w._push(out)
+
+    def _unsubscribe(self, w: Watch) -> None:
+        try:
+            self._watches.remove(w)
+        except ValueError:
+            pass
+
+    # ---------------------------------------------------------- durability
+
+    def _log_wal(self, rec: dict) -> None:
+        if self._wal is None or self._wal.fh is None:
+            return
+        self._wal.fh.write(json.dumps(rec, separators=(",", ":")) + "\n")
+        self._wal.fh.flush()
+        self._wal.mutations_since_snapshot += 1
+        if self._wal.mutations_since_snapshot >= self._wal.snapshot_every:
+            self.snapshot()
+
+    def _load_wal(self) -> None:
+        assert self._wal is not None
+        snap = self._wal.path + ".snap"
+        if os.path.exists(snap):
+            with open(snap, encoding="utf-8") as f:
+                data = json.load(f)
+            self._rv = data["rv"]
+            for rec in data["objects"]:
+                self._objects[tuple(rec["key"])] = rec["obj"]
+        if os.path.exists(self._wal.path):
+            with open(self._wal.path, encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    rec = json.loads(line)
+                    key = tuple(rec["key"])
+                    if rec["op"] == "put":
+                        self._objects[key] = rec["obj"]
+                    elif rec["op"] == "del":
+                        self._objects.pop(key, None)
+                    self._rv = max(self._rv, rec.get("rv", 0))
+
+    def snapshot(self) -> None:
+        """Write a snapshot and truncate the WAL (etcd compaction analog)."""
+        if self._wal is None:
+            return
+        snap = self._wal.path + ".snap"
+        tmp = snap + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(
+                {
+                    "rv": self._rv,
+                    "objects": [
+                        {"key": list(k), "obj": v} for k, v in self._objects.items()
+                    ],
+                },
+                f,
+            )
+        os.replace(tmp, snap)
+        if self._wal.fh is not None:
+            self._wal.fh.close()
+        self._wal.fh = open(self._wal.path, "w", encoding="utf-8")
+        self._wal.mutations_since_snapshot = 0
+
+    def close(self) -> None:
+        for w in list(self._watches):
+            w.close()
+        if self._wal is not None and self._wal.fh is not None:
+            self._wal.fh.close()
+            self._wal.fh = None
+
+    # ----------------------------------------------------------- internal
+
+    @staticmethod
+    def _non_status_changed(a: Mapping, b: Mapping) -> bool:
+        """True when anything outside .status and volatile metadata differs.
+
+        The host-side twin of the device diff kernel's spec lane
+        (reference behavior: pkg/syncer/specsyncer.go:17-41
+        deepEqualApartFromStatus ignores status + mutable metadata).
+        """
+
+        def strip(o: Mapping) -> dict:
+            o = {k: v for k, v in o.items() if k != "status"}
+            meta = dict(o.get("metadata") or {})
+            for f in ("resourceVersion", "generation", "managedFields", "creationTimestamp", "uid"):
+                meta.pop(f, None)
+            o["metadata"] = meta
+            return o
+
+        return strip(a) != strip(b)
+
+
+def iter_keys(store: LogicalStore) -> Iterator[Key]:
+    return iter(store._objects.keys())

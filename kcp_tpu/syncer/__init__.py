@@ -1,0 +1,4 @@
+from .engine import BatchSyncEngine, transform_for_downstream
+from .syncer import Syncer, start_syncer
+
+__all__ = ["BatchSyncEngine", "Syncer", "start_syncer", "transform_for_downstream"]

@@ -1,0 +1,77 @@
+"""Error taxonomy for the control plane.
+
+Mirrors the behavioral contract of the reference's apimachinery errors plus
+its retryable-error marker (reference: pkg/util/errors/retryable.go:3-19):
+a ``RetryableError`` is retried without counting against the bounded retry
+budget; everything else gets the workqueue's 5 rate-limited retries.
+"""
+
+from __future__ import annotations
+
+
+class ApiError(Exception):
+    """Base class for API-surface errors, carrying an HTTP-ish status code."""
+
+    code = 500
+    reason = "InternalError"
+
+    def __init__(self, message: str = ""):
+        super().__init__(message or self.reason)
+        self.message = message or self.reason
+
+
+class NotFoundError(ApiError):
+    code = 404
+    reason = "NotFound"
+
+
+class AlreadyExistsError(ApiError):
+    code = 409
+    reason = "AlreadyExists"
+
+
+class ConflictError(ApiError):
+    """Optimistic-concurrency failure (stale resourceVersion)."""
+
+    code = 409
+    reason = "Conflict"
+
+
+class InvalidError(ApiError):
+    code = 422
+    reason = "Invalid"
+
+
+class BadRequestError(ApiError):
+    code = 400
+    reason = "BadRequest"
+
+
+class RetryableError(Exception):
+    """Marker wrapper: retry the operation without a bounded retry budget.
+
+    Reference behavior: pkg/util/errors/retryable.go defines NewRetryableError/
+    IsRetryable; the syncer wraps not-yet-ready discovery in it
+    (pkg/syncer/syncer.go:119-122, 152-163) and controller error handlers
+    requeue such errors forever (pkg/reconciler/cluster/controller.go:253).
+    """
+
+    def __init__(self, cause: Exception | str):
+        super().__init__(str(cause))
+        self.cause = cause
+
+
+def is_retryable(err: BaseException) -> bool:
+    return isinstance(err, RetryableError)
+
+
+def is_not_found(err: BaseException) -> bool:
+    return isinstance(err, NotFoundError)
+
+
+def is_conflict(err: BaseException) -> bool:
+    return isinstance(err, ConflictError)
+
+
+def is_already_exists(err: BaseException) -> bool:
+    return isinstance(err, AlreadyExistsError)

@@ -1,0 +1,17 @@
+"""Test configuration: force JAX onto a virtual 8-device CPU mesh.
+
+Tests must be hermetic and multi-chip-shaped without TPU hardware, so we
+set the platform flags before jax is imported anywhere.
+"""
+
+import os
+
+# Force, don't setdefault: the environment pins JAX_PLATFORMS to the real
+# TPU platform, and tests must not depend on (or monopolize) the chip.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

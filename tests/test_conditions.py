@@ -1,0 +1,34 @@
+"""Condition helpers: transitions, lastTransitionTime stability."""
+
+from kcp_tpu.apis import conditions as c
+
+
+def test_set_and_find():
+    obj = {}
+    assert c.set_condition(obj, "Ready", c.TRUE, "AllGood")
+    cond = c.find_condition(obj, "Ready")
+    assert cond["status"] == "True"
+    assert cond["reason"] == "AllGood"
+    assert c.is_condition_true(obj, "Ready")
+
+
+def test_transition_time_only_moves_on_status_flip():
+    obj = {}
+    c.set_condition(obj, "Ready", c.TRUE)
+    t0 = c.find_condition(obj, "Ready")["lastTransitionTime"]
+    # same status, new message: no transition
+    changed = c.set_condition(obj, "Ready", c.TRUE, message="still fine")
+    assert changed
+    assert c.find_condition(obj, "Ready")["lastTransitionTime"] == t0
+    # unchanged call reports no change
+    assert not c.set_condition(obj, "Ready", c.TRUE, message="still fine")
+
+
+def test_remove():
+    obj = {}
+    c.set_condition(obj, "Ready", c.TRUE)
+    c.set_condition(obj, "Compatible", c.FALSE)
+    assert c.remove_condition(obj, "Ready")
+    assert c.find_condition(obj, "Ready") is None
+    assert c.find_condition(obj, "Compatible")
+    assert not c.remove_condition(obj, "Ready")

@@ -1,0 +1,113 @@
+"""Batched sync-decision kernel vs the host deep-diff oracle."""
+
+import numpy as np
+
+from kcp_tpu.ops.diff import (
+    DECISION_CREATE,
+    DECISION_DELETE,
+    DECISION_NOOP,
+    DECISION_UPDATE,
+    apply_deltas_jit,
+    sync_decisions_jit,
+)
+from kcp_tpu.ops.encode import BucketEncoder
+
+
+def obj(name, data, status=None):
+    o = {
+        "apiVersion": "v1",
+        "kind": "ConfigMap",
+        "metadata": {"name": name, "namespace": "d", "resourceVersion": "9"},
+        "data": data,
+    }
+    if status is not None:
+        o["status"] = status
+    return o
+
+
+def run(pairs):
+    """pairs: list of (upstream_obj|None, downstream_obj|None)."""
+    enc = BucketEncoder(capacity=64)
+    up = enc.encode_batch([p[0] for p in pairs])
+    down = enc.encode_batch([p[1] for p in pairs])
+    d = sync_decisions_jit(
+        up.values, up.exists, down.values, down.exists, enc.status_mask()
+    )
+    return np.asarray(d.decision), np.asarray(d.status_upsync)
+
+
+def test_decision_matrix():
+    decisions, upsync = run(
+        [
+            (obj("a", {"k": "v"}), obj("a", {"k": "v"})),  # in sync
+            (obj("b", {"k": "v"}), None),  # create downstream
+            (None, obj("c", {"k": "v"})),  # delete downstream
+            (obj("d", {"k": "NEW"}), obj("d", {"k": "old"})),  # spec update
+            (None, None),  # nothing anywhere
+        ]
+    )
+    assert decisions.tolist() == [
+        DECISION_NOOP,
+        DECISION_CREATE,
+        DECISION_DELETE,
+        DECISION_UPDATE,
+        DECISION_NOOP,
+    ]
+    assert not upsync.any()
+
+
+def test_status_lane_independent_of_spec_lane():
+    decisions, upsync = run(
+        [
+            # same spec, downstream grew status -> upsync only
+            (obj("a", {"k": "v"}), obj("a", {"k": "v"}, status={"ready": True})),
+            # spec differs AND status differs -> update + upsync
+            (obj("b", {"k": "1"}, status={"n": 1}), obj("b", {"k": "2"}, status={"n": 2})),
+            # only exists upstream -> no upsync possible
+            (obj("c", {}, status={"n": 1}), None),
+        ]
+    )
+    assert decisions.tolist() == [DECISION_NOOP, DECISION_UPDATE, DECISION_CREATE]
+    assert upsync.tolist() == [True, True, False]
+
+
+def test_volatile_metadata_does_not_dirty():
+    enc = BucketEncoder(capacity=64)
+    a = obj("a", {"k": "v"})
+    b = obj("a", {"k": "v"})
+    b["metadata"]["resourceVersion"] = "9999"
+    b["metadata"]["uid"] = "different"
+    up = enc.encode_batch([a])
+    down = enc.encode_batch([b])
+    d = sync_decisions_jit(up.values, up.exists, down.values, down.exists, enc.status_mask())
+    assert int(d.decision[0]) == DECISION_NOOP
+
+
+def test_apply_deltas_scatter_and_padding():
+    enc = BucketEncoder(capacity=32)
+    base = enc.encode_batch([obj("a", {"v": "0"}), obj("b", {"v": "0"}), None], pad_to=4)
+    vals, exists = base.values, base.exists
+
+    delta = enc.encode_batch([obj("b", {"v": "1"}), obj("c", {"v": "2"})], pad_to=4)
+    idx = np.array([1, 2, 0, 0], dtype=np.int32)
+    new_exists = np.array([True, True, False, False])
+    valid = np.array([True, True, False, False])
+
+    out_vals, out_exists = apply_deltas_jit(vals, exists, idx, delta.values, new_exists, valid)
+    out_vals, out_exists = np.asarray(out_vals), np.asarray(out_exists)
+    # row 1 updated, row 2 created, row 0 untouched by padding
+    np.testing.assert_array_equal(out_vals[0], vals[0])
+    np.testing.assert_array_equal(out_vals[1], delta.values[0])
+    np.testing.assert_array_equal(out_vals[2], delta.values[1])
+    assert out_exists.tolist() == [True, True, True, False]
+
+
+def test_delete_via_delta():
+    enc = BucketEncoder(capacity=32)
+    base = enc.encode_batch([obj("a", {"v": "0"})], pad_to=2)
+    idx = np.array([0, 0], dtype=np.int32)
+    zeros = np.zeros_like(base.values)
+    new_exists = np.array([False, False])
+    valid = np.array([True, False])
+    _, out_exists = apply_deltas_jit(base.values, base.exists, idx, zeros, new_exists, valid)
+    assert not np.asarray(out_exists)[0]

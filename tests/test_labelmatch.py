@@ -1,0 +1,75 @@
+"""Label-match kernel differential-tested against the host selector."""
+
+import numpy as np
+
+from kcp_tpu.ops.encode import encode_label_batch
+from kcp_tpu.ops.hashing import hash_pair
+from kcp_tpu.ops.labelmatch import (
+    compile_selector,
+    fanout_match_jit,
+    match_batch_jit,
+    match_host,
+)
+from kcp_tpu.store.selectors import parse_selector
+
+SELECTORS = [
+    "app=web",
+    "app!=web",
+    "env in (prod,staging)",
+    "env notin (prod)",
+    "app",
+    "!app",
+    "app=web,env in (prod,dev),!legacy,tier",
+    "kcp.dev/cluster=us-east1",
+    "",
+]
+
+
+def random_labels(rng):
+    keys = ["app", "env", "tier", "legacy", "kcp.dev/cluster"]
+    vals = {"app": ["web", "db"], "env": ["prod", "staging", "dev"], "tier": ["1", "2"],
+            "legacy": ["true"], "kcp.dev/cluster": ["us-east1", "us-west1"]}
+    labels = {}
+    for k in keys:
+        if rng.random() < 0.5:
+            labels[k] = vals[k][rng.integers(len(vals[k]))]
+    return labels or None
+
+
+def test_match_batch_vs_host():
+    rng = np.random.default_rng(7)
+    label_maps = [random_labels(rng) for _ in range(256)]
+    pairs, keys = encode_label_batch(label_maps, capacity=8)
+    for spec in SELECTORS:
+        sel = parse_selector(spec)
+        c = compile_selector(sel)
+        got = np.asarray(match_batch_jit(pairs, keys, c.alts, c.negate, c.use_key, c.valid))
+        want = match_host(sel, label_maps)
+        np.testing.assert_array_equal(got, want, err_msg=f"selector {spec!r}")
+
+
+def test_fanout_match():
+    clusters = [f"c{i}" for i in range(16)]
+    rng = np.random.default_rng(3)
+    label_maps = []
+    owner = []
+    for _ in range(512):
+        if rng.random() < 0.9:
+            c = clusters[rng.integers(len(clusters))]
+            label_maps.append({"kcp.dev/cluster": c, "x": "y"})
+            owner.append(c)
+        else:
+            label_maps.append({"x": "y"})
+            owner.append(None)
+    pairs, _ = encode_label_batch(label_maps, capacity=4)
+    sel_hashes = np.array(
+        [hash_pair("kcp.dev/cluster", c) for c in clusters], dtype=np.uint32
+    )
+    got = np.asarray(fanout_match_jit(pairs, sel_hashes))
+    assert got.shape == (512, 16)
+    for i, c in enumerate(owner):
+        row = got[i]
+        if c is None:
+            assert not row.any()
+        else:
+            assert row.sum() == 1 and row[clusters.index(c)]

@@ -1,0 +1,213 @@
+"""Controller runtime: queue semantics, retry policy, informers, batching."""
+
+import asyncio
+
+import pytest
+
+from kcp_tpu.client import Client, Informer
+from kcp_tpu.reconciler import Controller, WorkQueue
+from kcp_tpu.reconciler.controller import BatchController
+from kcp_tpu.store import LogicalStore
+from kcp_tpu.store.store import ADDED, DELETED, MODIFIED
+from kcp_tpu.utils.errors import RetryableError
+
+
+def cm(name, data=None):
+    return {"apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": name, "namespace": "d"}, "data": data or {}}
+
+
+# ----------------------------------------------------------------- queue
+
+def test_queue_dedup_while_pending():
+    async def main():
+        q = WorkQueue()
+        q.add("a")
+        q.add("a")
+        q.add("b")
+        assert await q.get() == "a"
+        assert await q.get() == "b"
+        q.done("a"), q.done("b")
+        assert len(q) == 0
+    asyncio.run(main())
+
+
+def test_queue_readd_during_processing_redelivers():
+    async def main():
+        q = WorkQueue()
+        q.add("a")
+        item = await q.get()
+        q.add("a")  # while processing -> redo after done
+        q.done(item)
+        assert await q.get() == "a"
+    asyncio.run(main())
+
+
+def test_queue_add_after_and_rate_limited():
+    async def main():
+        q = WorkQueue()
+        q.add_after("later", 0.02)
+        q.add("now")
+        assert await q.get() == "now"
+        q.done("now")
+        assert await q.get() == "later"
+        q.done("later")
+        q.add_rate_limited("x")
+        assert q.num_requeues("x") == 1
+        assert await q.get() == "x"
+        q.done("x")
+        q.forget("x")
+        assert q.num_requeues("x") == 0
+    asyncio.run(main())
+
+
+def test_queue_drain_batches():
+    async def main():
+        q = WorkQueue()
+        for i in range(10):
+            q.add(i)
+        batch = await q.drain(max_items=8, max_wait=0.001)
+        assert batch == list(range(8))
+        for i in batch:
+            q.done(i)
+        batch2 = await q.drain(max_items=8, max_wait=0.001)
+        assert batch2 == [8, 9]
+        for i in batch2:
+            q.done(i)
+    asyncio.run(main())
+
+
+# ------------------------------------------------------------ controller
+
+def test_controller_retries_then_drops():
+    async def main():
+        attempts = []
+
+        async def process(item):
+            attempts.append(item)
+            raise RuntimeError("boom")
+
+        c = Controller("t", process, max_retries=3)
+        await c.start(1)
+        c.enqueue("k")
+        await asyncio.sleep(0.3)
+        await c.stop()
+        # initial + 3 retries = 4 attempts, then dropped
+        assert len(attempts) == 4
+    asyncio.run(main())
+
+
+def test_controller_retryable_error_keeps_retrying():
+    async def main():
+        attempts = []
+        done = asyncio.Event()
+
+        async def process(item):
+            attempts.append(item)
+            if len(attempts) < 8:  # well past max_retries=2
+                raise RetryableError("not ready yet")
+            done.set()
+
+        c = Controller("t", process, max_retries=2)
+        await c.start(1)
+        c.enqueue("k")
+        await asyncio.wait_for(done.wait(), 5)
+        await c.stop()
+        assert len(attempts) == 8
+    asyncio.run(main())
+
+
+def test_batch_controller_processes_batches_and_retries_failures():
+    async def main():
+        batches = []
+        fail_once = {"bad"}
+
+        async def process_batch(items):
+            batches.append(list(items))
+            failed = []
+            for it in items:
+                if it in fail_once:
+                    fail_once.discard(it)
+                    failed.append((it, RuntimeError("flaky")))
+            return failed
+
+        c = BatchController("t", process_batch, batch_window=0.001)
+        await c.start()
+        for i in ["a", "b", "bad", "c"]:
+            c.enqueue(i)
+        await asyncio.sleep(0.3)
+        await c.stop()
+        flat = [i for b in batches for i in b]
+        assert flat.count("bad") == 2  # failed once, retried once
+        assert set(flat) == {"a", "b", "bad", "c"}
+        assert c.ticks >= 2
+    asyncio.run(main())
+
+
+# -------------------------------------------------------------- informer
+
+def test_informer_cache_events_and_index():
+    async def main():
+        store = LogicalStore()
+        client = Client(store, "tenant")
+        client.create("configmaps", cm("pre", {"k": "v"}))
+
+        inf = Informer(client, "configmaps")
+        events = []
+        inf.add_handler(lambda t, old, new: events.append((t, (new or old)["metadata"]["name"])))
+        inf.add_indexer("by_data_k", lambda o: [o.get("data", {}).get("k", "")])
+        await inf.start()
+        assert inf.synced
+        assert events == [(ADDED, "pre")]
+
+        client.create("configmaps", cm("x", {"k": "v"}))
+        obj = client.get("configmaps", "x", "d")
+        obj["data"]["k"] = "v2"
+        client.update("configmaps", obj)
+        client.delete("configmaps", "pre", "d")
+        await asyncio.sleep(0.05)
+
+        assert events[1:] == [(ADDED, "x"), (MODIFIED, "x"), (DELETED, "pre")]
+        assert inf.get("tenant", "x", "d")["data"]["k"] == "v2"
+        assert [o["metadata"]["name"] for o in inf.index("by_data_k", "v2")] == ["x"]
+        assert inf.index("by_data_k", "v") == []
+        await inf.stop()
+    asyncio.run(main())
+
+
+def test_informer_resync_replays_cache():
+    async def main():
+        store = LogicalStore()
+        client = Client(store, "t")
+        client.create("configmaps", cm("a"))
+        inf = Informer(client, "configmaps")
+        await inf.start()
+        events = []
+        inf.add_handler(lambda t, old, new: events.append(t))
+        assert events == [ADDED]  # replay to late subscriber
+        inf.resync()
+        assert events == [ADDED, MODIFIED]
+        await inf.stop()
+    asyncio.run(main())
+
+
+def test_informer_wildcard_spans_tenants():
+    async def main():
+        store = LogicalStore()
+        from kcp_tpu.client import MultiClusterClient
+        mc = MultiClusterClient(store)
+        Client(store, "a").create("configmaps", cm("x"))
+        Client(store, "b").create("configmaps", cm("x"))
+        inf = Informer(mc, "configmaps")
+        await inf.start()
+        assert len(inf.list()) == 2
+        Client(store, "c").create("configmaps", cm("y"))
+        await asyncio.sleep(0.05)
+        assert len(inf.list()) == 3
+        await inf.stop()
+    asyncio.run(main())
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(pytest.main([__file__, "-q"]))

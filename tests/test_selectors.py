@@ -1,0 +1,58 @@
+"""Label-selector parsing/matching — the host-side truth the device
+labelmatch kernel is differentially tested against."""
+
+import pytest
+
+from kcp_tpu.store.selectors import parse_selector, selector_from_dict
+
+
+@pytest.mark.parametrize(
+    "spec,labels,want",
+    [
+        ("", {"a": "b"}, True),
+        ("a=b", {"a": "b"}, True),
+        ("a=b", {"a": "c"}, False),
+        ("a=b", {}, False),
+        ("a==b", {"a": "b"}, True),
+        ("a!=b", {"a": "c"}, True),
+        ("a!=b", {}, True),  # absent key satisfies !=
+        ("a!=b", {"a": "b"}, False),
+        ("a=b,c=d", {"a": "b", "c": "d"}, True),
+        ("a=b,c=d", {"a": "b"}, False),
+        ("env in (prod,staging)", {"env": "prod"}, True),
+        ("env in (prod,staging)", {"env": "dev"}, False),
+        ("env in (prod,staging)", {}, False),
+        ("env notin (prod)", {"env": "dev"}, True),
+        ("env notin (prod)", {}, True),
+        ("env notin (prod)", {"env": "prod"}, False),
+        ("env", {"env": "x"}, True),
+        ("env", {}, False),
+        ("!env", {}, True),
+        ("!env", {"env": "x"}, False),
+        ("kcp.dev/cluster=us-east1", {"kcp.dev/cluster": "us-east1"}, True),
+        ("env in (a,b),tier=web,!legacy", {"env": "b", "tier": "web"}, True),
+    ],
+)
+def test_parse_and_match(spec, labels, want):
+    assert parse_selector(spec).matches(labels) is want
+
+
+def test_selector_from_dict():
+    sel = selector_from_dict(
+        {
+            "matchLabels": {"app": "web"},
+            "matchExpressions": [
+                {"key": "env", "operator": "In", "values": ["prod"]},
+                {"key": "legacy", "operator": "DoesNotExist"},
+            ],
+        }
+    )
+    assert sel.matches({"app": "web", "env": "prod"})
+    assert not sel.matches({"app": "web", "env": "dev"})
+    assert not sel.matches({"app": "web", "env": "prod", "legacy": "1"})
+
+
+def test_roundtrip_str():
+    spec = "a=b,env in (p,q),!gone,have"
+    sel = parse_selector(spec)
+    assert parse_selector(str(sel)) == sel

@@ -1,0 +1,167 @@
+"""LogicalStore semantics: keys, RVs, watches, wildcard, finalizers, WAL."""
+
+import pytest
+
+from kcp_tpu.store import LogicalStore, parse_selector
+from kcp_tpu.store.store import ADDED, DELETED, MODIFIED, WILDCARD
+from kcp_tpu.utils import errors
+
+
+def cm(name, ns="default", data=None, labels=None):
+    obj = {"apiVersion": "v1", "kind": "ConfigMap", "metadata": {"name": name, "namespace": ns},
+           "data": data or {}}
+    if labels:
+        obj["metadata"]["labels"] = labels
+    return obj
+
+
+def test_create_get_roundtrip():
+    s = LogicalStore()
+    created = s.create("configmaps", "tenant-a", cm("x", data={"k": "v"}))
+    assert created["metadata"]["resourceVersion"] == "1"
+    assert created["metadata"]["clusterName"] == "tenant-a"
+    assert created["metadata"]["generation"] == 1
+    got = s.get("configmaps", "tenant-a", "x", "default")
+    assert got["data"] == {"k": "v"}
+    # tenancy isolation: same name in another logical cluster is distinct
+    with pytest.raises(errors.NotFoundError):
+        s.get("configmaps", "tenant-b", "x", "default")
+    s.create("configmaps", "tenant-b", cm("x", data={"k": "other"}))
+    assert s.get("configmaps", "tenant-b", "x", "default")["data"] == {"k": "other"}
+
+
+def test_create_duplicate_rejected():
+    s = LogicalStore()
+    s.create("configmaps", "t", cm("x"))
+    with pytest.raises(errors.AlreadyExistsError):
+        s.create("configmaps", "t", cm("x"))
+
+
+def test_update_optimistic_concurrency():
+    s = LogicalStore()
+    obj = s.create("configmaps", "t", cm("x"))
+    stale = dict(obj, data={"a": "1"})
+    fresh = s.update("configmaps", "t", stale)
+    assert fresh["metadata"]["resourceVersion"] == "2"
+    # stale RV now conflicts
+    with pytest.raises(errors.ConflictError):
+        s.update("configmaps", "t", dict(obj, data={"b": "2"}))
+
+
+def test_generation_bumps_on_spec_not_status():
+    s = LogicalStore()
+    obj = s.create("configmaps", "t", cm("x"))
+    obj["data"] = {"a": "1"}
+    obj = s.update("configmaps", "t", obj)
+    assert obj["metadata"]["generation"] == 2
+    obj["status"] = {"phase": "Ready"}
+    obj2 = s.update_status("configmaps", "t", obj)
+    assert obj2["metadata"]["generation"] == 2
+    assert obj2["status"] == {"phase": "Ready"}
+
+
+def test_status_not_writable_via_spec_update():
+    s = LogicalStore()
+    obj = s.create("configmaps", "t", cm("x"))
+    obj["status"] = {"phase": "Sneaky"}
+    updated = s.update("configmaps", "t", obj)
+    assert "status" not in updated
+    updated["status"] = {"phase": "Real"}
+    s.update_status("configmaps", "t", updated)
+    again = s.get("configmaps", "t", "x", "default")
+    again["data"] = {"z": "9"}
+    again2 = s.update("configmaps", "t", again)
+    assert again2["status"] == {"phase": "Real"}  # preserved across spec update
+
+
+def test_list_filters_cluster_namespace_selector():
+    s = LogicalStore()
+    s.create("configmaps", "a", cm("x", labels={"app": "web"}))
+    s.create("configmaps", "a", cm("y", ns="other", labels={"app": "db"}))
+    s.create("configmaps", "b", cm("z", labels={"app": "web"}))
+    items, rv = s.list("configmaps", "a")
+    assert [i["metadata"]["name"] for i in items] == ["x", "y"]  # sorted by (cluster, ns, name)
+    items, _ = s.list("configmaps", WILDCARD)
+    assert len(items) == 3
+    assert rv == s.resource_version
+    items, _ = s.list("configmaps", WILDCARD, selector=parse_selector("app=web"))
+    assert {i["metadata"]["clusterName"] for i in items} == {"a", "b"}
+    items, _ = s.list("configmaps", "a", namespace="other")
+    assert len(items) == 1
+
+
+def test_watch_events_and_wildcard():
+    s = LogicalStore()
+    w_a = s.watch("configmaps", "a")
+    w_all = s.watch("configmaps", WILDCARD)
+    w_sel = s.watch("configmaps", WILDCARD, selector=parse_selector("app=web"))
+    s.create("configmaps", "a", cm("x", labels={"app": "web"}))
+    s.create("configmaps", "b", cm("y"))
+    obj = s.get("configmaps", "a", "x", "default")
+    obj["data"] = {"k": "v"}
+    s.update("configmaps", "a", obj)
+    s.delete("configmaps", "b", "y", "default")
+
+    evs_a = w_a.drain()
+    assert [e.type for e in evs_a] == [ADDED, MODIFIED]
+    evs_all = w_all.drain()
+    assert [e.type for e in evs_all] == [ADDED, ADDED, MODIFIED, DELETED]
+    evs_sel = w_sel.drain()
+    assert all(e.cluster == "a" for e in evs_sel)
+
+
+def test_watch_resume_from_rv():
+    s = LogicalStore()
+    s.create("configmaps", "t", cm("x"))
+    _, rv = s.list("configmaps", "t")
+    s.create("configmaps", "t", cm("y"))
+    w = s.watch("configmaps", "t", since_rv=rv)
+    evs = w.drain()
+    assert [e.name for e in evs] == ["y"]
+
+
+def test_finalizers_defer_deletion():
+    s = LogicalStore()
+    obj = cm("x")
+    obj["metadata"]["finalizers"] = ["example.dev/cleanup"]
+    s.create("configmaps", "t", obj)
+    s.delete("configmaps", "t", "x", "default")
+    got = s.get("configmaps", "t", "x", "default")  # still there
+    assert got["metadata"]["deletionTimestamp"]
+    got["metadata"]["finalizers"] = []
+    s.update("configmaps", "t", got)
+    with pytest.raises(errors.NotFoundError):
+        s.get("configmaps", "t", "x", "default")
+
+
+def test_wal_persistence_and_snapshot(tmp_path):
+    wal = str(tmp_path / "store.wal")
+    s = LogicalStore(wal_path=wal)
+    s.create("configmaps", "t", cm("x", data={"k": "v"}))
+    obj = s.get("configmaps", "t", "x", "default")
+    obj["data"] = {"k": "v2"}
+    s.update("configmaps", "t", obj)
+    s.create("configmaps", "t", cm("gone"))
+    s.delete("configmaps", "t", "gone", "default")
+    rv = s.resource_version
+    s.close()
+
+    s2 = LogicalStore(wal_path=wal)
+    assert s2.resource_version == rv
+    assert s2.get("configmaps", "t", "x", "default")["data"] == {"k": "v2"}
+    with pytest.raises(errors.NotFoundError):
+        s2.get("configmaps", "t", "gone", "default")
+    s2.snapshot()
+    s2.create("configmaps", "t", cm("after-snap"))
+    s2.close()
+
+    s3 = LogicalStore(wal_path=wal)
+    assert s3.get("configmaps", "t", "after-snap", "default")
+    assert s3.get("configmaps", "t", "x", "default")["data"] == {"k": "v2"}
+    s3.close()
+
+
+def test_wildcard_writes_rejected():
+    s = LogicalStore()
+    with pytest.raises(errors.InvalidError):
+        s.create("configmaps", WILDCARD, cm("x"))
