@@ -1,0 +1,223 @@
+#!/usr/bin/env python
+"""End-to-end demo scenarios — the analog of the reference's contrib/demo.
+
+The reference drives two demo-magic scripts against kind clusters and
+diffs normalized output against golden files (runDemos.sh, SURVEY.md §4).
+Here the same scenarios run hermetically against fake physical clusters
+and print a normalized transcript; ``--check`` compares it against the
+committed golden file.
+
+Scenarios:
+- ``apiNegotiation`` — register us-east1, import, publish, CRD
+  established; register us-west1 with a narrower schema and observe
+  Compatible=False on its import (reference: contrib/demo/apiNegotiation:36-60)
+- ``kubecon`` — register two clusters, create a root Deployment, watch it
+  split, sync down, and aggregate status back up
+  (reference: contrib/demo/kubecon)
+
+Usage:
+    python contrib/demo/run_demo.py [apiNegotiation|kubecon|all] [--check]
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+os.environ.setdefault("DEMO_JAX_PLATFORM", "cpu")
+if os.environ["DEMO_JAX_PLATFORM"] == "cpu":
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+from kcp_tpu.apis import apiresource as ar  # noqa: E402
+from kcp_tpu.apis import cluster as clusterapi  # noqa: E402
+from kcp_tpu.apis import conditions as cond  # noqa: E402
+from kcp_tpu.apis import crd as crdapi  # noqa: E402
+from kcp_tpu.client import MultiClusterClient  # noqa: E402
+from kcp_tpu.physical import FakeClusterAgent, PhysicalRegistry  # noqa: E402
+from kcp_tpu.reconcilers.apiresource import NegotiationController  # noqa: E402
+from kcp_tpu.reconcilers.cluster import ClusterController, SyncerMode  # noqa: E402
+from kcp_tpu.reconcilers.crdlifecycle import CRDLifecycleController  # noqa: E402
+from kcp_tpu.reconcilers.deployment import DeploymentSplitter  # noqa: E402
+from kcp_tpu.store import LogicalStore  # noqa: E402
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "demo.result")
+
+out_lines: list[str] = []
+
+
+def emit(line: str) -> None:
+    out_lines.append(line)
+    print(line)
+
+
+async def eventually(pred, timeout=20.0, desc="condition"):
+    loop = asyncio.get_event_loop()
+    end = loop.time() + timeout
+    last = None
+    while loop.time() < end:
+        try:
+            last = pred()
+            if last:
+                return last
+        except Exception as e:  # noqa: BLE001
+            last = repr(e)
+        await asyncio.sleep(0.02)
+    raise RuntimeError(f"demo timed out waiting for {desc} (last={last!r})")
+
+
+class ControlPlane:
+    """One in-process control plane with all controllers running."""
+
+    def __init__(self):
+        self.store = LogicalStore()
+        self.client = MultiClusterClient(self.store)
+        self.registry = PhysicalRegistry()
+        self.negotiation = NegotiationController(self.client, auto_publish=True)
+        self.lifecycle = CRDLifecycleController(self.client)
+        self.clusters = ClusterController(
+            self.client, self.registry, resources_to_sync=["deployments.apps"],
+            mode=SyncerMode.PUSH, poll_interval=0.2, import_poll_interval=0.2,
+        )
+        self.splitter = DeploymentSplitter(self.client)
+        self.agents: list[FakeClusterAgent] = []
+
+    async def start(self):
+        await self.negotiation.start()
+        await self.lifecycle.start()
+        await self.clusters.start()
+        await self.splitter.start()
+
+    async def add_physical(self, name: str) -> None:
+        client = self.registry.resolve(f"fake://{name}")
+        agent = FakeClusterAgent(client)
+        await agent.start()
+        self.agents.append(agent)
+
+    async def stop(self):
+        for a in self.agents:
+            await a.stop()
+        await self.splitter.stop()
+        await self.clusters.stop()
+        await self.lifecycle.stop()
+        await self.negotiation.stop()
+
+
+async def demo_api_negotiation() -> None:
+    emit("=== demo: apiNegotiation ===")
+    cp = ControlPlane()
+    await cp.start()
+    await cp.add_physical("us-east1")
+    t = cp.client.cluster_client("admin")
+
+    emit("$ kubectl apply cluster us-east1")
+    t.create(clusterapi.CLUSTERS, clusterapi.new_cluster("us-east1", "fake://us-east1"))
+    await eventually(
+        lambda: ar.is_compatible_and_available(
+            t.get(ar.APIRESOURCEIMPORTS, "us-east1.deployments.v1.apps")),
+        desc="us-east1 import compatible+available")
+    emit("apiresourceimport us-east1.deployments.v1.apps: Compatible=True Available=True")
+    await eventually(lambda: crdapi.is_established(t.get(crdapi.CRDS, "deployments.apps")),
+                     desc="deployments CRD established")
+    emit("crd deployments.apps: Established=True")
+    await eventually(lambda: clusterapi.is_ready(t.get(clusterapi.CLUSTERS, "us-east1")),
+                     desc="us-east1 Ready")
+    emit("cluster us-east1: Ready=True syncedResources="
+         + ",".join(clusterapi.synced_resources(t.get(clusterapi.CLUSTERS, "us-east1"))))
+
+    emit("$ kubectl apply cluster us-west1 (narrower deployment schema)")
+    # us-west1's fake cluster serves a deployments CRD whose spec.replicas
+    # is a string -> incompatible with the negotiated integer schema
+    west = cp.registry.resolve("fake://us-west1")
+    bad = crdapi.new_crd("apps", "v1", "deployments", "Deployment", schema={
+        "type": "object",
+        "properties": {"spec": {"type": "object", "properties": {
+            "replicas": {"type": "string"}}}},
+    })
+    west.create(crdapi.CRDS, bad)
+    await cp.add_physical("us-west1")
+    t.create(clusterapi.CLUSTERS, clusterapi.new_cluster("us-west1", "fake://us-west1"))
+
+    imp = await eventually(
+        lambda: (lambda o: cond.find_condition(o, ar.COMPATIBLE) is not None and o)(
+            t.get(ar.APIRESOURCEIMPORTS, "us-west1.deployments.v1.apps")),
+        desc="us-west1 import processed")
+    c = cond.find_condition(imp, ar.COMPATIBLE)
+    emit(f"apiresourceimport us-west1.deployments.v1.apps: Compatible={c['status']}"
+         f" reason={c.get('reason', '')}")
+    await cp.stop()
+
+
+async def demo_kubecon() -> None:
+    emit("=== demo: kubecon ===")
+    cp = ControlPlane()
+    await cp.start()
+    await cp.add_physical("east")
+    await cp.add_physical("west")
+    t = cp.client.cluster_client("kubecon")
+
+    emit("$ kubectl apply cluster east west")
+    t.create(clusterapi.CLUSTERS, clusterapi.new_cluster("east", "fake://east"))
+    t.create(clusterapi.CLUSTERS, clusterapi.new_cluster("west", "fake://west"))
+    await eventually(lambda: clusterapi.is_ready(t.get(clusterapi.CLUSTERS, "east"))
+                     and clusterapi.is_ready(t.get(clusterapi.CLUSTERS, "west")),
+                     desc="both clusters ready")
+    emit("cluster east: Ready=True")
+    emit("cluster west: Ready=True")
+
+    emit("$ kubectl apply deployment demo replicas=10")
+    t.create("deployments.apps", {
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": "demo", "namespace": "default"},
+        "spec": {"replicas": 10,
+                 "selector": {"matchLabels": {"app": "demo"}},
+                 "template": {"metadata": {"labels": {"app": "demo"}},
+                              "spec": {"containers": [{"name": "demo", "image": "x"}]}}},
+    })
+    east = cp.registry.resolve("fake://east")
+    west = cp.registry.resolve("fake://west")
+    await eventually(lambda: east.get("deployments.apps", "demo--east", "default"),
+                     desc="east physical deployment")
+    await eventually(lambda: west.get("deployments.apps", "demo--west", "default"),
+                     desc="west physical deployment")
+    e = east.get("deployments.apps", "demo--east", "default")["spec"]["replicas"]
+    w = west.get("deployments.apps", "demo--west", "default")["spec"]["replicas"]
+    emit(f"deployment demo--east synced to east with replicas={e}")
+    emit(f"deployment demo--west synced to west with replicas={w}")
+    await eventually(
+        lambda: t.get("deployments.apps", "demo", "default")
+        .get("status", {}).get("readyReplicas") == 10,
+        desc="root status aggregation")
+    st = t.get("deployments.apps", "demo", "default")["status"]
+    emit(f"deployment demo status: replicas={st['replicas']} ready={st['readyReplicas']}"
+         f" available={st['availableReplicas']}")
+    await cp.stop()
+
+
+async def main() -> int:
+    which = sys.argv[1] if len(sys.argv) > 1 and not sys.argv[1].startswith("-") else "all"
+    if which in ("apiNegotiation", "all"):
+        await demo_api_negotiation()
+    if which in ("kubecon", "all"):
+        await demo_kubecon()
+    if "--check" in sys.argv:
+        if which != "all":
+            print("--check requires running all scenarios", file=sys.stderr)
+            return 2
+        want = open(GOLDEN, encoding="utf-8").read().splitlines()
+        got = out_lines
+        if want != got:
+            print("GOLDEN MISMATCH", file=sys.stderr)
+            for w, g in zip(want + [""] * len(got), got + [""] * len(want)):
+                if w != g:
+                    print(f"- {w}\n+ {g}", file=sys.stderr)
+            return 1
+        print("golden check: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(asyncio.run(main()))

@@ -113,4 +113,5 @@ class MultiClusterClient(Client):
         self._enabled = set(resources) if resources is not None else None
 
     def cluster_client(self, cluster: str) -> Client:
-        return Client(self._store, cluster)
+        # share the scheme: CRD registrations must be visible to every view
+        return Client(self._store, cluster, self.scheme)

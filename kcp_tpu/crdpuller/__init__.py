@@ -1,0 +1,3 @@
+from .puller import SchemaPuller
+
+__all__ = ["SchemaPuller"]

@@ -1,0 +1,159 @@
+"""Schema puller: physical-cluster discovery -> CRD synthesis.
+
+The analog of the reference's crd-puller (pkg/crdpuller/discovery.go):
+given a client to a physical cluster, produce a CRD for each requested
+resource, either from a CRD the cluster already defines or synthesized
+from discovery metadata plus known schemas (the reference hardcodes
+schemas for meta types in ``knownPackages``, discovery.go:481-569; here
+the known-schema table covers the core types the demos sync).
+
+The puller works against any Client (in-process fake physical cluster or
+the REST client), which is what makes kind-free end-to-end tests possible
+(SURVEY.md §4 implication).
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+
+from ..apis import crd as crdapi
+from ..apis.scheme import GVR
+from ..client import Client
+from ..utils import errors
+
+log = logging.getLogger(__name__)
+
+# Minimal structural schemas for well-known types (knownPackages analog).
+_STRING = {"type": "string"}
+_INT = {"type": "integer"}
+_OBJECT_PRESERVE = {"type": "object", "x-kubernetes-preserve-unknown-fields": True}
+_STRING_MAP = {"type": "object", "additionalProperties": {"type": "string"}}
+
+KNOWN_SCHEMAS: dict[str, dict] = {
+    "configmaps": {
+        "type": "object",
+        "properties": {
+            "apiVersion": _STRING,
+            "kind": _STRING,
+            "metadata": _OBJECT_PRESERVE,
+            "data": _STRING_MAP,
+            "binaryData": _STRING_MAP,
+            "immutable": {"type": "boolean"},
+        },
+    },
+    "secrets": {
+        "type": "object",
+        "properties": {
+            "apiVersion": _STRING,
+            "kind": _STRING,
+            "metadata": _OBJECT_PRESERVE,
+            "data": _STRING_MAP,
+            "stringData": _STRING_MAP,
+            "type": _STRING,
+            "immutable": {"type": "boolean"},
+        },
+    },
+    "deployments": {
+        "type": "object",
+        "properties": {
+            "apiVersion": _STRING,
+            "kind": _STRING,
+            "metadata": _OBJECT_PRESERVE,
+            "spec": {
+                "type": "object",
+                "properties": {
+                    "replicas": _INT,
+                    "selector": _OBJECT_PRESERVE,
+                    "template": _OBJECT_PRESERVE,
+                    "strategy": _OBJECT_PRESERVE,
+                    "minReadySeconds": _INT,
+                    "paused": {"type": "boolean"},
+                },
+            },
+            "status": {
+                "type": "object",
+                "properties": {
+                    "replicas": _INT,
+                    "updatedReplicas": _INT,
+                    "readyReplicas": _INT,
+                    "availableReplicas": _INT,
+                    "unavailableReplicas": _INT,
+                    "observedGeneration": _INT,
+                    "conditions": {"type": "array", "items": _OBJECT_PRESERVE},
+                },
+            },
+        },
+    },
+    "services": {
+        "type": "object",
+        "properties": {
+            "apiVersion": _STRING,
+            "kind": _STRING,
+            "metadata": _OBJECT_PRESERVE,
+            "spec": _OBJECT_PRESERVE,
+            "status": _OBJECT_PRESERVE,
+        },
+    },
+    "pods": {
+        "type": "object",
+        "properties": {
+            "apiVersion": _STRING,
+            "kind": _STRING,
+            "metadata": _OBJECT_PRESERVE,
+            "spec": _OBJECT_PRESERVE,
+            "status": _OBJECT_PRESERVE,
+        },
+    },
+}
+
+
+class SchemaPuller:
+    """Pulls CRDs for named resources from a physical cluster client."""
+
+    def __init__(self, physical: Client):
+        self.physical = physical
+
+    def pull_crds(self, resources: list[str]) -> dict[str, dict | None]:
+        """resource name (``plural`` or ``plural.group``) -> CRD dict or
+        None when the cluster doesn't serve it (reference: PullCRDs,
+        discovery.go:85-287)."""
+        out: dict[str, dict | None] = {}
+        for res in resources:
+            gvr = GVR.parse(res)
+            crd = self._from_existing_crd(gvr)
+            if crd is None:
+                crd = self._synthesize(gvr)
+            out[res] = crd
+        return out
+
+    def _from_existing_crd(self, gvr: GVR) -> dict | None:
+        """The cluster defines this resource as a CRD: pull it as-is
+        (discovery.go:157-175)."""
+        name = crdapi.crd_name(gvr.resource, gvr.group)
+        try:
+            crd = self.physical.get(crdapi.CRDS, name)
+        except errors.NotFoundError:
+            return None
+        crd = copy.deepcopy(crd)
+        crd["metadata"] = {"name": name}
+        crd.pop("status", None)
+        return crd
+
+    def _synthesize(self, gvr: GVR) -> dict | None:
+        """Discovery + known schemas -> synthesized CRD
+        (discovery.go:176-287)."""
+        info = self.physical.scheme.by_resource(gvr.storage_name)
+        if info is None or gvr.storage_name not in self.physical.resources():
+            return None
+        schema = KNOWN_SCHEMAS.get(gvr.resource, _OBJECT_PRESERVE)
+        has_status = "status" in (schema.get("properties") or {})
+        return crdapi.new_crd(
+            group=info.gvr.group,
+            version=info.gvr.version,
+            plural=info.gvr.resource,
+            kind=info.kind,
+            scope="Namespaced" if info.namespaced else "Cluster",
+            schema=copy.deepcopy(schema),
+            subresources={"status": {}} if has_status else None,
+        )

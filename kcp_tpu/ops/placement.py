@@ -16,17 +16,23 @@ import jax
 import jax.numpy as jnp
 
 
-def split_replicas(replicas: jax.Array, avail: jax.Array) -> jax.Array:
-    """Even split with remainder to the first available clusters.
+def split_replicas(replicas: jax.Array, avail: jax.Array, balanced: bool = False) -> jax.Array:
+    """Even split with the remainder going to the first available cluster.
 
     replicas: int32 [B]   desired root replicas
-    avail:    bool  [B,P] cluster availability (Ready and not excluded)
+    avail:    bool  [B,P] cluster availability (registered, not excluded)
     returns:  int32 [B,P] leaf replica counts (0 where unavailable)
 
-    Parity: floor division + remainder-to-first, matching
-    deployment.go:127-145 (``replicas/len(cls)`` then ``+1`` for the
-    first ``replicas%len(cls)`` leafs). With no available clusters the
-    row is all zeros (host sets Progressing=False, deployment.go:110-123).
+    Parity (default): floor division, then the WHOLE remainder on the
+    first cluster, matching deployment.go:127-145 (``replicasEach :=
+    replicas / len(cls)``, ``rest := replicas % len(cls)``, and
+    ``index == 0`` receives ``replicasEach + rest``). With no available
+    clusters the row is all zeros (the host sets Progressing=False,
+    deployment.go:110-123).
+
+    ``balanced=True`` instead spreads the remainder +1 over the first
+    ``rest`` clusters (max-min <= 1) — a strictly more even placement
+    offered as an opt-in improvement over the reference.
     """
     avail_i = avail.astype(jnp.int32)
     n = avail_i.sum(axis=-1, keepdims=True)  # [B,1]
@@ -35,7 +41,11 @@ def split_replicas(replicas: jax.Array, avail: jax.Array) -> jax.Array:
     rem = replicas[:, None] - base * n_safe
     # rank of each available cluster among available ones, in column order
     rank = jnp.cumsum(avail_i, axis=-1) - 1
-    leaf = base + (rank < rem).astype(jnp.int32)
+    if balanced:
+        extra = (rank < rem).astype(jnp.int32)
+    else:
+        extra = (rank == 0) * rem
+    leaf = base + extra
     return jnp.where(avail & (n > 0), leaf, 0)
 
 
@@ -56,5 +66,5 @@ def placement_changed(current: jax.Array, desired: jax.Array) -> jax.Array:
     return (current != desired).any(axis=-1)
 
 
-split_replicas_jit = jax.jit(split_replicas)
+split_replicas_jit = jax.jit(split_replicas, static_argnames=("balanced",))
 aggregate_status_jit = jax.jit(aggregate_status)

@@ -1,0 +1,3 @@
+from .fake import FakeClusterAgent, PhysicalRegistry
+
+__all__ = ["PhysicalRegistry", "FakeClusterAgent"]

@@ -1,0 +1,113 @@
+"""Fake physical clusters — the framework's kind-replacement.
+
+The reference tests against real kind (Kubernetes-in-Docker) clusters
+(contrib/demo/clusters/kind/). This framework ships an in-process
+substitute so the whole multi-cluster story — registration, API import,
+sync, placement — runs hermetically (SURVEY.md §4 implication):
+
+- :class:`PhysicalRegistry` resolves a Cluster's ``spec.kubeconfig`` to a
+  client. ``fake://<name>`` creates/returns an in-process store; anything
+  else is resolved by pluggable factories (the REST client registers an
+  ``https://`` factory).
+- :class:`FakeClusterAgent` plays the part of the cluster's controllers:
+  it marks Deployments ready (status counters follow spec.replicas), so
+  pull-mode health checks and status upsync have something to observe.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Callable
+
+from ..client import Client, Informer
+from ..store.store import LogicalStore
+
+log = logging.getLogger(__name__)
+
+FAKE_PREFIX = "fake://"
+PHYSICAL_CLUSTER_NAME = "physical"
+
+
+class PhysicalRegistry:
+    """kubeconfig string -> physical-cluster Client."""
+
+    def __init__(self):
+        self._fakes: dict[str, LogicalStore] = {}
+        self._factories: dict[str, Callable[[str], Client]] = {}
+
+    def register_factory(self, scheme: str, factory: Callable[[str], Client]) -> None:
+        self._factories[scheme] = factory
+
+    def resolve(self, kubeconfig: str) -> Client:
+        if not kubeconfig or not kubeconfig.strip():
+            raise ValueError("empty kubeconfig")
+        if kubeconfig.startswith(FAKE_PREFIX):
+            name = kubeconfig[len(FAKE_PREFIX):]
+            if not name:
+                raise ValueError("fake:// kubeconfig needs a cluster name")
+            store = self._fakes.get(name)
+            if store is None:
+                store = LogicalStore()
+                self._fakes[name] = store
+            return Client(store, PHYSICAL_CLUSTER_NAME)
+        scheme = kubeconfig.split("://", 1)[0] if "://" in kubeconfig else ""
+        factory = self._factories.get(scheme)
+        if factory is None:
+            raise ValueError(f"unsupported kubeconfig {kubeconfig!r}")
+        return factory(kubeconfig)
+
+    def fake_store(self, name: str) -> LogicalStore | None:
+        return self._fakes.get(name)
+
+
+class FakeClusterAgent:
+    """Simulates a physical cluster's deployment controller: any
+    Deployment becomes fully ready shortly after creation/update."""
+
+    def __init__(self, client: Client, delay: float = 0.0):
+        self.client = client
+        self.delay = delay
+        self.informer = Informer(client, "deployments.apps")
+        self._tasks: set[asyncio.Task] = set()
+        self.informer.add_handler(self._on_event)
+
+    def _on_event(self, etype: str, old: dict | None, new: dict | None) -> None:
+        if etype == "DELETED" or new is None:
+            return
+        replicas = (new.get("spec") or {}).get("replicas", 0) or 0
+        status = new.get("status") or {}
+        if status.get("readyReplicas") == replicas and status.get("replicas") == replicas:
+            return
+        t = asyncio.get_event_loop().create_task(self._mark_ready(new, replicas))
+        self._tasks.add(t)
+        t.add_done_callback(self._tasks.discard)
+
+    async def _mark_ready(self, obj: dict, replicas: int) -> None:
+        if self.delay:
+            await asyncio.sleep(self.delay)
+        m = obj["metadata"]
+        try:
+            fresh = self.client.get("deployments.apps", m["name"], m.get("namespace", ""))
+            fresh["status"] = {
+                "replicas": replicas,
+                "updatedReplicas": replicas,
+                "readyReplicas": replicas,
+                "availableReplicas": replicas,
+                "unavailableReplicas": 0,
+                "observedGeneration": fresh["metadata"].get("generation", 1),
+                "conditions": [{"type": "Available", "status": "True",
+                                "reason": "MinimumReplicasAvailable"}],
+            }
+            self.client.update_status("deployments.apps", fresh,
+                                      namespace=m.get("namespace", ""))
+        except Exception:  # noqa: BLE001 — object may be gone; agent is best-effort
+            log.debug("fake agent: could not mark %s ready", m.get("name"))
+
+    async def start(self) -> None:
+        await self.informer.start()
+
+    async def stop(self) -> None:
+        for t in list(self._tasks):
+            t.cancel()
+        await self.informer.stop()

@@ -1,0 +1,3 @@
+from .controller import NegotiationController
+
+__all__ = ["NegotiationController"]

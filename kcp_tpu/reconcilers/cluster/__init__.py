@@ -1,0 +1,3 @@
+from .controller import ClusterController, SyncerMode
+
+__all__ = ["ClusterController", "SyncerMode"]

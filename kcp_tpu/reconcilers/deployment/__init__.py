@@ -1,0 +1,3 @@
+from .controller import DeploymentSplitter
+
+__all__ = ["DeploymentSplitter"]

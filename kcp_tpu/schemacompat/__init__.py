@@ -1,0 +1,3 @@
+from .lcd import CompatError, ensure_structural_schema_compatibility
+
+__all__ = ["ensure_structural_schema_compatibility", "CompatError"]

@@ -1,13 +1,17 @@
 """Test configuration: force JAX onto a virtual 8-device CPU mesh.
 
-Tests must be hermetic and multi-chip-shaped without TPU hardware, so we
-set the platform flags before jax is imported anywhere.
+Tests must be hermetic and multi-chip-shaped without TPU hardware. Two
+subtleties of this environment:
+
+- a sitecustomize hook imports jax at interpreter startup and the env
+  pins JAX_PLATFORMS to the TPU platform, so setting the env var here is
+  too late — ``jax.config.update`` is the lever that actually works;
+- XLA_FLAGS is still read lazily at CPU-backend creation, so the
+  virtual-device flag can be injected here.
 """
 
 import os
 
-# Force, don't setdefault: the environment pins JAX_PLATFORMS to the real
-# TPU platform, and tests must not depend on (or monopolize) the chip.
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
@@ -15,3 +19,13 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_sessionstart(session):
+    # fail fast if the platform override did not take: a hung TPU tunnel
+    # would otherwise stall the whole suite on the first jit call
+    assert jax.devices()[0].platform == "cpu", jax.devices()

@@ -1,0 +1,186 @@
+"""The whole control plane, end to end — the kubecon demo as a test.
+
+Register two fake physical clusters in a logical cluster; watch the
+pipeline run: API import -> negotiation -> CRD publication -> synced
+resources -> push syncer -> deployment splitting -> spec downsync ->
+fake cluster agents mark workloads ready -> status upsync -> root status
+aggregation. (Reference scenario: contrib/demo/kubecon + docs/architecture.)
+"""
+
+import asyncio
+
+import pytest
+
+from kcp_tpu.apis import apiresource as ar
+from kcp_tpu.apis import cluster as clusterapi
+from kcp_tpu.client import MultiClusterClient
+from kcp_tpu.physical import FakeClusterAgent, PhysicalRegistry
+from kcp_tpu.reconcilers.apiresource import NegotiationController
+from kcp_tpu.reconcilers.cluster import ClusterController, SyncerMode
+from kcp_tpu.reconcilers.crdlifecycle import CRDLifecycleController
+from kcp_tpu.reconcilers.deployment import DeploymentSplitter
+from kcp_tpu.store import LogicalStore
+from kcp_tpu.utils.errors import NotFoundError
+
+
+async def eventually(pred, timeout=10.0, msg=""):
+    loop = asyncio.get_event_loop()
+    end = loop.time() + timeout
+    last = None
+    while loop.time() < end:
+        try:
+            last = pred()
+            if last:
+                return last
+        except Exception as e:  # noqa: BLE001
+            last = repr(e)
+        await asyncio.sleep(0.02)
+    raise AssertionError(f"{msg or 'condition not reached'} (last={last!r})")
+
+
+def deployment(name, replicas):
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {"replicas": replicas,
+                 "selector": {"matchLabels": {"app": name}},
+                 "template": {"metadata": {"labels": {"app": name}},
+                              "spec": {"containers": [{"name": name, "image": "x"}]}}},
+    }
+
+
+@pytest.mark.parametrize("backend", ["tpu"])
+def test_full_multi_cluster_loop(backend):
+    async def main():
+        store = LogicalStore()
+        mc = MultiClusterClient(store)
+        registry = PhysicalRegistry()
+
+        negc = NegotiationController(mc, auto_publish=True, backend=backend)
+        lifecycle = CRDLifecycleController(mc)
+        clusterc = ClusterController(
+            mc, registry, resources_to_sync=["deployments.apps"],
+            mode=SyncerMode.PUSH, backend=backend,
+            poll_interval=0.2, import_poll_interval=0.2,
+        )
+        splitter = DeploymentSplitter(mc, backend=backend)
+        await negc.start()
+        await lifecycle.start()
+        await clusterc.start()
+        await splitter.start()
+
+        # physical clusters come alive with fake agents
+        east = registry.resolve("fake://east")
+        west = registry.resolve("fake://west")
+        agents = [FakeClusterAgent(east), FakeClusterAgent(west)]
+        for a in agents:
+            await a.start()
+
+        t = mc.cluster_client("org-team-1")
+        t.create(clusterapi.CLUSTERS, clusterapi.new_cluster("us-east1", "fake://east"))
+        t.create(clusterapi.CLUSTERS, clusterapi.new_cluster("us-west1", "fake://west"))
+
+        # pipeline: imports appear, negotiated published, clusters Ready with
+        # deployments.apps in syncedResources
+        await eventually(
+            lambda: ar.is_compatible_and_available(
+                t.get(ar.APIRESOURCEIMPORTS, "us-east1.deployments.v1.apps")),
+            msg="east import not compatible+available")
+        await eventually(
+            lambda: clusterapi.is_ready(t.get(clusterapi.CLUSTERS, "us-east1"))
+            and "deployments.apps" in clusterapi.synced_resources(
+                t.get(clusterapi.CLUSTERS, "us-east1")),
+            msg="east cluster not ready/synced")
+        await eventually(
+            lambda: clusterapi.is_ready(t.get(clusterapi.CLUSTERS, "us-west1")),
+            msg="west cluster not ready")
+
+        # a root deployment splits across both clusters and syncs down
+        t.create("deployments.apps", deployment("demo", 10))
+        await eventually(lambda: t.get("deployments.apps", "demo--us-east1", "default"),
+                         msg="east leaf missing")
+        await eventually(lambda: east.get("deployments.apps", "demo--us-east1", "default"),
+                         msg="east physical copy missing")
+        await eventually(lambda: west.get("deployments.apps", "demo--us-west1", "default"),
+                         msg="west physical copy missing")
+        e_phys = east.get("deployments.apps", "demo--us-east1", "default")
+        w_phys = west.get("deployments.apps", "demo--us-west1", "default")
+        assert e_phys["spec"]["replicas"] + w_phys["spec"]["replicas"] == 10
+
+        # fake agents mark them ready; status flows up to the leafs, then
+        # aggregates into the root
+        await eventually(
+            lambda: t.get("deployments.apps", "demo", "default")
+            .get("status", {}).get("readyReplicas") == 10,
+            timeout=15, msg="root status not aggregated")
+
+        # scale-down path: deleting the root's leaf upstream deletes downstream
+        t.delete("deployments.apps", "demo--us-east1", "default")
+        await eventually(
+            lambda: _gone(lambda: east.get("deployments.apps", "demo--us-east1", "default")),
+            msg="east physical copy not deleted")
+
+        for a in agents:
+            await a.stop()
+        await splitter.stop()
+        await clusterc.stop()
+        await lifecycle.stop()
+        await negc.stop()
+
+    def _gone(f):
+        try:
+            f()
+            return False
+        except NotFoundError:
+            return True
+
+    asyncio.run(main())
+
+
+def test_invalid_kubeconfig_not_ready_no_retry():
+    async def main():
+        store = LogicalStore()
+        mc = MultiClusterClient(store)
+        registry = PhysicalRegistry()
+        clusterc = ClusterController(mc, registry, poll_interval=0.2)
+        await clusterc.start()
+        t = mc.cluster_client("t")
+        t.create(clusterapi.CLUSTERS, clusterapi.new_cluster("bad", "garbage://nope"))
+        await eventually(lambda: (
+            lambda c: not clusterapi.is_ready(c)
+            and (c.get("status", {}).get("conditions") or [{}])[0].get("reason")
+            == clusterapi.REASON_INVALID_KUBECONFIG
+        )(t.get(clusterapi.CLUSTERS, "bad")))
+        await clusterc.stop()
+    asyncio.run(main())
+
+
+def test_cluster_deletion_cleanup():
+    async def main():
+        store = LogicalStore()
+        mc = MultiClusterClient(store)
+        registry = PhysicalRegistry()
+        negc = NegotiationController(mc, auto_publish=True)
+        lifecycle = CRDLifecycleController(mc)
+        clusterc = ClusterController(
+            mc, registry, mode=SyncerMode.PUSH,
+            poll_interval=0.2, import_poll_interval=0.2,
+        )
+        await negc.start()
+        await lifecycle.start()
+        await clusterc.start()
+        t = mc.cluster_client("t")
+        t.create(clusterapi.CLUSTERS, clusterapi.new_cluster("c1", "fake://c1"))
+        await eventually(lambda: clusterapi.is_ready(t.get(clusterapi.CLUSTERS, "c1")),
+                         msg="cluster never ready")
+        assert ("t", "c1") in clusterc.importers
+        assert ("t", "c1") in clusterc.syncers
+        t.delete(clusterapi.CLUSTERS, "c1")
+        await eventually(lambda: ("t", "c1") not in clusterc.syncers
+                         and ("t", "c1") not in clusterc.importers,
+                         msg="cleanup did not run")
+        await clusterc.stop()
+        await lifecycle.stop()
+        await negc.stop()
+    asyncio.run(main())

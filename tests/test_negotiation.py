@@ -1,0 +1,249 @@
+"""Negotiation controller: the 3-way CRD/import/negotiated state machine.
+
+Mirrors the end-to-end assertions of the reference's apiNegotiation demo
+(contrib/demo/apiNegotiation:36-60): first import founds + publishes the
+negotiated resource; a second, narrower import is flagged Compatible=False.
+"""
+
+import asyncio
+
+import pytest
+
+from kcp_tpu.apis import apiresource as ar
+from kcp_tpu.apis import conditions as cond
+from kcp_tpu.apis import crd as crdapi
+from kcp_tpu.client import MultiClusterClient
+from kcp_tpu.reconcilers.apiresource import NegotiationController
+from kcp_tpu.reconcilers.crdlifecycle import CRDLifecycleController
+from kcp_tpu.store import LogicalStore
+
+
+def widget_spec(schema=None, version="v1alpha1"):
+    return ar.common_spec("example.io", version, "widgets", "Widget",
+                          schema=schema or {"type": "object", "properties": {
+                              "spec": {"type": "object", "properties": {
+                                  "size": {"type": "integer"}}}}},
+                          sub_resources=["status"])
+
+
+async def eventually(pred, timeout=5.0):
+    loop = asyncio.get_event_loop()
+    end = loop.time() + timeout
+    last = None
+    while loop.time() < end:
+        try:
+            last = pred()
+            if last:
+                return last
+        except Exception:
+            pass
+        await asyncio.sleep(0.01)
+    raise AssertionError(f"condition not reached (last={last!r})")
+
+
+def setup_controllers(store, auto_publish=True):
+    mc = MultiClusterClient(store)
+    neg = NegotiationController(mc, auto_publish=auto_publish)
+    lifecycle = CRDLifecycleController(mc)
+    return mc, neg, lifecycle
+
+
+def test_import_founds_negotiated_and_publishes_crd():
+    async def main():
+        store = LogicalStore()
+        mc, negc, lifecycle = setup_controllers(store)
+        await negc.start()
+        await lifecycle.start()
+        t = mc.cluster_client("tenant")
+
+        imp = ar.new_api_resource_import("us-east1", widget_spec())
+        t.create(ar.APIRESOURCEIMPORTS, imp)
+
+        # negotiated resource appears, Submitted, then Published via CRD
+        neg = await eventually(
+            lambda: t.get(ar.NEGOTIATEDAPIRESOURCES, "widgets.v1alpha1.example.io")
+        )
+        assert neg["spec"]["publish"] is True
+        crd = await eventually(lambda: t.get(crdapi.CRDS, "widgets.example.io"))
+        assert crd["spec"]["names"]["kind"] == "Widget"
+        assert crd["spec"]["versions"][0]["name"] == "v1alpha1"
+        assert crd["spec"]["versions"][0]["subresources"] == {"status": {}}
+        # lifecycle establishes -> negotiation marks Published -> import Available
+        await eventually(lambda: crdapi.is_established(t.get(crdapi.CRDS, "widgets.example.io")))
+        await eventually(lambda: cond.is_condition_true(
+            t.get(ar.NEGOTIATEDAPIRESOURCES, "widgets.v1alpha1.example.io"), ar.PUBLISHED))
+        imp_now = await eventually(lambda: (
+            lambda o: ar.is_compatible_and_available(o) and o
+        )(t.get(ar.APIRESOURCEIMPORTS, imp["metadata"]["name"])))
+        assert cond.is_condition_true(imp_now, ar.COMPATIBLE)
+        # the widget resource is now served
+        assert "widgets.example.io" in t.resources()
+        await negc.stop()
+        await lifecycle.stop()
+    asyncio.run(main())
+
+
+def test_second_incompatible_import_flagged():
+    """The apiNegotiation demo's core assertion: us-west1's narrower schema
+    (string size vs integer size) gets Compatible=False."""
+    async def main():
+        store = LogicalStore()
+        mc, negc, lifecycle = setup_controllers(store)
+        await negc.start()
+        await lifecycle.start()
+        t = mc.cluster_client("tenant")
+
+        t.create(ar.APIRESOURCEIMPORTS, ar.new_api_resource_import("us-east1", widget_spec()))
+        await eventually(lambda: cond.is_condition_true(
+            t.get(ar.NEGOTIATEDAPIRESOURCES, "widgets.v1alpha1.example.io"), ar.PUBLISHED))
+
+        bad_schema = {"type": "object", "properties": {
+            "spec": {"type": "object", "properties": {"size": {"type": "string"}}}}}
+        imp2 = ar.new_api_resource_import("us-west1", widget_spec(schema=bad_schema))
+        t.create(ar.APIRESOURCEIMPORTS, imp2)
+
+        imp2_now = await eventually(lambda: (
+            lambda o: cond.find_condition(o, ar.COMPATIBLE) and o
+        )(t.get(ar.APIRESOURCEIMPORTS, imp2["metadata"]["name"])))
+        c = cond.find_condition(imp2_now, ar.COMPATIBLE)
+        assert c["status"] == "False"
+        assert "IncompatibleSchema" == c["reason"]
+        assert "type changed" in c["message"]
+        # the first import stays healthy
+        assert ar.is_compatible_and_available(
+            t.get(ar.APIRESOURCEIMPORTS, "us-east1.widgets.v1alpha1.example.io"))
+        await negc.stop()
+        await lifecycle.stop()
+    asyncio.run(main())
+
+
+def test_compatible_import_narrows_lcd():
+    """A second import missing an optional property narrows the negotiated
+    schema to the LCD (UpdatePublished strategy allows it)."""
+    async def main():
+        store = LogicalStore()
+        mc, negc, lifecycle = setup_controllers(store)
+        await negc.start()
+        await lifecycle.start()
+        t = mc.cluster_client("tenant")
+
+        rich = {"type": "object", "properties": {
+            "spec": {"type": "object", "properties": {
+                "size": {"type": "integer"}, "color": {"type": "string"}}}}}
+        poor = {"type": "object", "properties": {
+            "spec": {"type": "object", "properties": {
+                "size": {"type": "integer"}}}}}
+        t.create(ar.APIRESOURCEIMPORTS, ar.new_api_resource_import("east", widget_spec(rich)))
+        await eventually(lambda: t.get(ar.NEGOTIATEDAPIRESOURCES, "widgets.v1alpha1.example.io"))
+        t.create(ar.APIRESOURCEIMPORTS, ar.new_api_resource_import("west", widget_spec(poor)))
+
+        def narrowed():
+            neg = t.get(ar.NEGOTIATEDAPIRESOURCES, "widgets.v1alpha1.example.io")
+            props = neg["spec"]["openAPIV3Schema"]["properties"]["spec"]["properties"]
+            return "color" not in props and "size" in props
+        await eventually(narrowed)
+        await negc.stop()
+        await lifecycle.stop()
+    asyncio.run(main())
+
+
+def test_manually_created_crd_enforces():
+    async def main():
+        store = LogicalStore()
+        mc, negc, lifecycle = setup_controllers(store)
+        await negc.start()
+        await lifecycle.start()
+        t = mc.cluster_client("tenant")
+
+        # import founds a negotiated resource first
+        t.create(ar.APIRESOURCEIMPORTS, ar.new_api_resource_import("east", widget_spec()))
+        await eventually(lambda: t.get(ar.NEGOTIATEDAPIRESOURCES, "widgets.v1alpha1.example.io"))
+
+        # an operator manually applies a CRD for the same GVR (no owner ref)
+        manual_schema = {"type": "object", "properties": {
+            "spec": {"type": "object", "properties": {"mode": {"type": "string"}}}}}
+        manual = crdapi.new_crd("example.io", "v1alpha1", "widgets", "Widget",
+                                schema=manual_schema)
+        try:
+            t.create(crdapi.CRDS, manual)
+        except Exception:
+            existing = t.get(crdapi.CRDS, "widgets.example.io")
+            existing["spec"]["versions"][0]["schema"]["openAPIV3Schema"] = manual_schema
+            existing["metadata"]["ownerReferences"] = []
+            t.update(crdapi.CRDS, existing)
+
+        neg = await eventually(lambda: (
+            lambda o: cond.is_condition_true(o, ar.ENFORCED) and o
+        )(t.get(ar.NEGOTIATEDAPIRESOURCES, "widgets.v1alpha1.example.io")))
+        # schema overwritten by the CRD's
+        await eventually(lambda: t.get(
+            ar.NEGOTIATEDAPIRESOURCES, "widgets.v1alpha1.example.io"
+        )["spec"]["openAPIV3Schema"] == manual_schema)
+        del neg
+        await negc.stop()
+        await lifecycle.stop()
+    asyncio.run(main())
+
+
+def test_orphan_negotiated_deleted_when_last_import_goes():
+    async def main():
+        store = LogicalStore()
+        mc, negc, lifecycle = setup_controllers(store)
+        await negc.start()
+        await lifecycle.start()
+        t = mc.cluster_client("tenant")
+        imp = ar.new_api_resource_import("east", widget_spec())
+        t.create(ar.APIRESOURCEIMPORTS, imp)
+        await eventually(lambda: t.get(ar.NEGOTIATEDAPIRESOURCES, "widgets.v1alpha1.example.io"))
+        t.delete(ar.APIRESOURCEIMPORTS, imp["metadata"]["name"])
+
+        def neg_gone():
+            try:
+                t.get(ar.NEGOTIATEDAPIRESOURCES, "widgets.v1alpha1.example.io")
+                return False
+            except Exception:
+                return True
+        await eventually(neg_gone)
+        await negc.stop()
+        await lifecycle.stop()
+    asyncio.run(main())
+
+
+def test_lcd_memoization_across_identical_tenants():
+    """configs[3] shape: many tenants with identical schemas walk the LCD
+    tree O(distinct), not O(imports)."""
+    async def main():
+        store = LogicalStore()
+        mc, negc, lifecycle = setup_controllers(store)
+        await negc.start()
+        await lifecycle.start()
+        for i in range(40):
+            t = mc.cluster_client(f"tenant-{i}")
+            t.create(ar.APIRESOURCEIMPORTS, ar.new_api_resource_import("east", widget_spec()))
+        await eventually(lambda: all(
+            cond.is_condition_true(
+                mc.cluster_client(f"tenant-{i}").get(
+                    ar.NEGOTIATEDAPIRESOURCES, "widgets.v1alpha1.example.io"),
+                ar.PUBLISHED)
+            for i in range(40)), timeout=15)
+        # second wave: every tenant's west import folds into its negotiated
+        # resource — 40 structurally identical LCD comparisons
+        for i in range(40):
+            t = mc.cluster_client(f"tenant-{i}")
+            t.create(ar.APIRESOURCEIMPORTS, ar.new_api_resource_import("west", widget_spec()))
+        await eventually(lambda: all(
+            ar.is_compatible_and_available(
+                mc.cluster_client(f"tenant-{i}").get(
+                    ar.APIRESOURCEIMPORTS, "west.widgets.v1alpha1.example.io"))
+            for i in range(40)), timeout=15)
+        # identical (negotiated, import) schema pairs hit the memo
+        assert negc.stats["lcd_hits"] > 0
+        assert negc.stats["lcd_walks"] < 40
+        await negc.stop()
+        await lifecycle.stop()
+    asyncio.run(main())
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(pytest.main([__file__, "-q"]))

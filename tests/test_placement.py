@@ -1,6 +1,7 @@
 """Replica bin-packing kernel vs a python oracle of the reference behavior."""
 
 import numpy as np
+import pytest
 
 from kcp_tpu.ops.placement import (
     aggregate_status_jit,
@@ -9,9 +10,10 @@ from kcp_tpu.ops.placement import (
 )
 
 
-def oracle_split(replicas: int, avail: list[bool]) -> list[int]:
+def oracle_split(replicas: int, avail: list[bool], balanced: bool = False) -> list[int]:
     """Reference behavior (deployment.go:127-145): even split over available
-    clusters, remainder +1 to the first ones, in order."""
+    clusters; the WHOLE remainder lands on the first one (index == 0 gets
+    replicasEach + rest). balanced=True spreads the remainder +1-each."""
     idxs = [i for i, a in enumerate(avail) if a]
     out = [0] * len(avail)
     if not idxs:
@@ -19,11 +21,15 @@ def oracle_split(replicas: int, avail: list[bool]) -> list[int]:
     n = len(idxs)
     base, rem = divmod(replicas, n)
     for rank, i in enumerate(idxs):
-        out[i] = base + (1 if rank < rem else 0)
+        if balanced:
+            out[i] = base + (1 if rank < rem else 0)
+        else:
+            out[i] = base + (rem if rank == 0 else 0)
     return out
 
 
-def test_matches_oracle_exhaustive_small():
+@pytest.mark.parametrize("balanced", [False, True])
+def test_matches_oracle_exhaustive_small(balanced):
     cases = []
     for replicas in range(0, 12):
         for mask_bits in range(16):
@@ -31,8 +37,8 @@ def test_matches_oracle_exhaustive_small():
             cases.append((replicas, avail))
     reps = np.array([c[0] for c in cases], dtype=np.int32)
     avail = np.array([c[1] for c in cases], dtype=bool)
-    got = np.asarray(split_replicas_jit(reps, avail))
-    want = np.array([oracle_split(*c) for c in cases], dtype=np.int32)
+    got = np.asarray(split_replicas_jit(reps, avail, balanced=balanced))
+    want = np.array([oracle_split(r, a, balanced) for r, a in cases], dtype=np.int32)
     np.testing.assert_array_equal(got, want)
 
 
@@ -48,9 +54,11 @@ def test_conservation_and_shape_at_scale():
     assert (leaf.sum(-1)[n == 0] == 0).all()
     # nothing placed on unavailable clusters
     assert (leaf[~avail] == 0).all()
-    # balance: max-min <= 1 among available
-    masked_max = np.where(avail, leaf, 0).max(-1)
-    masked_min = np.where(avail, leaf, np.iinfo(np.int32).max).min(-1)
+    # balanced mode: max-min <= 1 among available
+    leaf_b = np.asarray(split_replicas_jit(reps, avail, balanced=True))
+    np.testing.assert_array_equal(leaf_b.sum(-1)[n > 0], reps[n > 0])
+    masked_max = np.where(avail, leaf_b, 0).max(-1)
+    masked_min = np.where(avail, leaf_b, np.iinfo(np.int32).max).min(-1)
     ok = n > 0
     assert ((masked_max - masked_min)[ok] <= 1).all()
 

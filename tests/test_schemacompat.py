@@ -1,0 +1,192 @@
+"""LCD/compat engine: the reference's table tests (schemacompat_test.go:12-200)
+re-expressed over dict schemas, plus coverage the reference lacked."""
+
+import pytest
+
+from kcp_tpu.schemacompat import ensure_structural_schema_compatibility as ensure
+
+
+def obj(props=None, additional=None):
+    s = {"type": "object"}
+    if props is not None:
+        s["properties"] = props
+    if additional is not None:
+        s["additionalProperties"] = additional
+    return s
+
+
+S = {"type": "string"}
+I = {"type": "integer"}
+
+
+# ---- the reference's table (same cases, same expectations) ----
+
+def test_new_has_more_properties():
+    lcd, errs = ensure(obj({"existing": S}), obj({"existing": S, "new": I}))
+    assert errs == []
+    assert lcd == obj({"existing": S})
+
+
+def test_new_has_fewer_properties_errors():
+    lcd, errs = ensure(obj({"existing": S, "new": I}), obj({"existing": S}))
+    assert len(errs) == 1
+    assert "properties have been removed in an incompatible way" in errs[0]
+    assert "'new'" in errs[0]
+
+
+def test_new_has_fewer_properties_narrow():
+    lcd, errs = ensure(obj({"existing": S, "new": I}), obj({"existing": S}), narrow_existing=True)
+    assert errs == []
+    assert lcd == obj({"existing": S})
+
+
+def test_additional_properties_schema_compatible():
+    existing = obj({
+        "prop1": obj({"subProp1": S}),
+        "prop2": obj({"subProp1": S, "subProp2": S}),
+    })
+    new = obj(additional=obj({"subProp1": S, "subProp2": S}))
+    lcd, errs = ensure(existing, new)
+    assert errs == []
+    assert lcd == existing
+
+
+def test_additional_properties_schema_incompatible():
+    existing = obj({
+        "prop1": obj({"subProp1": S}),
+        "prop2": obj({"subProp1": S, "subProp2": S}),
+    })
+    new = obj(additional=obj({"subProp1": S}))
+    lcd, errs = ensure(existing, new)
+    assert len(errs) == 1
+    assert "properties[prop2].properties" in errs[0]
+    assert "subProp2" in errs[0]
+
+
+def test_additional_properties_bool_allows_everything():
+    existing = obj({"existing": S})
+    lcd, errs = ensure(existing, obj(additional=True))
+    assert errs == []
+    assert lcd == existing
+
+
+# ---- coverage beyond the reference table ----
+
+def test_type_change_errors():
+    _, errs = ensure(S, I)
+    assert any("type changed" in e for e in errs)
+
+
+def test_integer_widened_to_number_ok_keeps_integer():
+    lcd, errs = ensure(I, {"type": "number"})
+    assert errs == []
+    assert lcd["type"] == "integer"
+
+
+def test_number_narrowed_to_integer_requires_narrow_mode():
+    _, errs = ensure({"type": "number"}, I)
+    assert any("type changed" in e for e in errs)
+    lcd, errs = ensure({"type": "number"}, I, narrow_existing=True)
+    assert errs == []
+    assert lcd["type"] == "integer"
+
+
+def test_string_enum_intersection():
+    existing = {"type": "string", "enum": ["a", "b", "c"]}
+    new = {"type": "string", "enum": ["b", "c", "d"]}
+    _, errs = ensure(existing, new)
+    assert any("enum value has been changed" in e for e in errs)
+    lcd, errs = ensure(existing, new, narrow_existing=True)
+    assert errs == []
+    assert lcd["enum"] == ["b", "c"]
+
+
+def test_string_format_change_errors():
+    _, errs = ensure({"type": "string", "format": "date"}, {"type": "string"})
+    assert any("format" in e for e in errs)
+
+
+def test_unsupported_constructs_fail_closed():
+    _, errs = ensure({"type": "string", "allOf": [S]}, {"type": "string", "allOf": [S]})
+    assert any("not supported" in e for e in errs)
+    _, errs = ensure({"type": "integer", "maximum": 5}, {"type": "integer", "maximum": 10})
+    assert any("not supported" in e for e in errs)
+    # equal numeric bounds pass
+    _, errs = ensure({"type": "integer", "maximum": 5}, {"type": "integer", "maximum": 5})
+    assert errs == []
+
+
+def test_array_items_recursion_and_unique_items():
+    existing = {"type": "array", "items": obj({"a": S})}
+    new = {"type": "array", "items": obj({"a": S, "b": I})}
+    lcd, errs = ensure(existing, new)
+    assert errs == []
+    assert lcd == existing
+    # uniqueItems tightening: error, unless narrowing (then LCD adopts it)
+    _, errs = ensure({"type": "array", "items": S},
+                     {"type": "array", "items": S, "uniqueItems": True})
+    assert any("uniqueItems" in e for e in errs)
+    lcd, errs = ensure({"type": "array", "items": S},
+                       {"type": "array", "items": S, "uniqueItems": True},
+                       narrow_existing=True)
+    assert errs == []
+    assert lcd["uniqueItems"] is True
+
+
+def test_properties_cleared_errors():
+    _, errs = ensure(obj({"a": S}), obj())
+    assert any("completely cleared" in e for e in errs)
+
+
+def test_additional_properties_schema_to_schema_recurses():
+    existing = obj(additional=obj({"x": S}))
+    new = obj(additional=obj({"x": S, "y": I}))
+    lcd, errs = ensure(existing, new)
+    assert errs == []
+    assert lcd == existing
+    _, errs = ensure(new, existing)
+    assert errs  # property removed inside additionalProperties schema
+
+
+def test_additional_properties_true_tightened():
+    _, errs = ensure(obj(additional=True), obj(additional=obj({"x": S})))
+    assert any("additionalProperties" in e for e in errs)
+    lcd, errs = ensure(obj(additional=True), obj(additional=obj({"x": S})),
+                       narrow_existing=True)
+    assert errs == []
+    assert lcd["additionalProperties"] == obj({"x": S})
+
+
+def test_int_or_string():
+    ios = {"x-kubernetes-int-or-string": True,
+           "anyOf": [{"type": "integer"}, {"type": "string"}]}
+    lcd, errs = ensure(ios, ios)
+    assert errs == []
+    assert lcd == ios
+    not_ios = {"type": "string"}
+    _, errs = ensure(ios, not_ios)
+    assert errs
+
+
+def test_preserve_unknown_fields_change_errors():
+    _, errs = ensure({"type": "object", "x-kubernetes-preserve-unknown-fields": True},
+                     obj())
+    assert any("x-kubernetes-preserve-unknown-fields" in e for e in errs)
+
+
+def test_new_none_means_nothing_allowed():
+    _, errs = ensure(obj({"a": S}), None)
+    assert any("doesn't allow anything" in e for e in errs)
+
+
+def test_nested_narrowing_composes():
+    existing = obj({"spec": obj({"a": S, "b": {"type": "string", "enum": ["x", "y"]}})})
+    new = obj({"spec": obj({"b": {"type": "string", "enum": ["y", "z"]}})})
+    lcd, errs = ensure(existing, new, narrow_existing=True)
+    assert errs == []
+    assert lcd == obj({"spec": obj({"b": {"type": "string", "enum": ["y"]}})})
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(pytest.main([__file__, "-q"]))

@@ -1,0 +1,156 @@
+"""Deployment splitter: split, no-clusters condition, status fan-in."""
+
+import asyncio
+
+import pytest
+
+from kcp_tpu.apis.cluster import new_cluster
+from kcp_tpu.client import MultiClusterClient
+from kcp_tpu.reconcilers.deployment import DeploymentSplitter
+from kcp_tpu.reconcilers.deployment.controller import DEPLOYMENTS
+from kcp_tpu.store import LogicalStore
+
+
+def deployment(name, replicas, ns="default"):
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {"replicas": replicas, "template": {"spec": {"containers": []}}},
+    }
+
+
+async def eventually(pred, timeout=5.0):
+    loop = asyncio.get_event_loop()
+    end = loop.time() + timeout
+    while loop.time() < end:
+        try:
+            if pred():
+                return
+        except Exception:
+            pass
+        await asyncio.sleep(0.01)
+    raise AssertionError("condition not reached")
+
+
+@pytest.mark.parametrize("backend", ["tpu", "host"])
+def test_split_and_aggregate(backend):
+    async def main():
+        store = LogicalStore()
+        mc = MultiClusterClient(store)
+        tenant = mc.cluster_client("tenant-1")
+        tenant.create("clusters.cluster.example.dev", new_cluster("us-east1"))
+        tenant.create("clusters.cluster.example.dev", new_cluster("us-west1"))
+
+        splitter = DeploymentSplitter(mc, backend=backend)
+        await splitter.start()
+
+        tenant.create(DEPLOYMENTS, deployment("web", 10))
+        # reference split: 2 clusters, 10 replicas -> first gets base+rest
+        await eventually(lambda: tenant.get(DEPLOYMENTS, "web--us-east1", "default"))
+        east = tenant.get(DEPLOYMENTS, "web--us-east1", "default")
+        west = tenant.get(DEPLOYMENTS, "web--us-west1", "default")
+        assert east["spec"]["replicas"] == 5
+        assert west["spec"]["replicas"] == 5
+        assert east["metadata"]["labels"]["kcp.dev/cluster"] == "us-east1"
+        assert east["metadata"]["labels"]["kcp.dev/owned-by"] == "web"
+        assert east["metadata"]["ownerReferences"][0]["name"] == "web"
+
+        # leaf status flows up, summed, conditions from first leaf
+        for leaf_name, ready in (("web--us-east1", 5), ("web--us-west1", 4)):
+            leaf = tenant.get(DEPLOYMENTS, leaf_name, "default")
+            leaf["status"] = {
+                "replicas": 5, "updatedReplicas": 5, "readyReplicas": ready,
+                "availableReplicas": ready, "unavailableReplicas": 5 - ready,
+                "conditions": [{"type": "Available", "status": "True"}],
+            }
+            tenant.update_status(DEPLOYMENTS, leaf)
+        await eventually(
+            lambda: tenant.get(DEPLOYMENTS, "web", "default").get("status", {}).get("readyReplicas") == 9
+        )
+        root = tenant.get(DEPLOYMENTS, "web", "default")
+        assert root["status"]["replicas"] == 10
+        assert root["status"]["unavailableReplicas"] == 1
+        assert root["status"]["conditions"] == [{"type": "Available", "status": "True"}]
+        await splitter.stop()
+    asyncio.run(main())
+
+
+def test_remainder_goes_to_first_cluster():
+    async def main():
+        store = LogicalStore()
+        mc = MultiClusterClient(store)
+        t = mc.cluster_client("t")
+        for name in ("a-cl", "b-cl", "c-cl"):
+            t.create("clusters.cluster.example.dev", new_cluster(name))
+        splitter = DeploymentSplitter(mc)
+        await splitter.start()
+        t.create(DEPLOYMENTS, deployment("api", 10))
+        await eventually(lambda: t.get(DEPLOYMENTS, "api--c-cl", "default"))
+        counts = [t.get(DEPLOYMENTS, f"api--{c}", "default")["spec"]["replicas"]
+                  for c in ("a-cl", "b-cl", "c-cl")]
+        assert counts == [4, 3, 3]  # whole remainder on the first
+        await splitter.stop()
+    asyncio.run(main())
+
+
+def test_no_clusters_sets_progressing_false():
+    async def main():
+        store = LogicalStore()
+        mc = MultiClusterClient(store)
+        t = mc.cluster_client("empty-tenant")
+        splitter = DeploymentSplitter(mc)
+        await splitter.start()
+        t.create(DEPLOYMENTS, deployment("web", 3))
+        await eventually(
+            lambda: (t.get(DEPLOYMENTS, "web", "default").get("status", {}).get("conditions")
+                     or [{}])[0].get("reason") == "NoRegisteredClusters"
+        )
+        await splitter.stop()
+    asyncio.run(main())
+
+
+def test_tenancy_isolation_between_logical_clusters():
+    async def main():
+        store = LogicalStore()
+        mc = MultiClusterClient(store)
+        t1 = mc.cluster_client("t1")
+        t2 = mc.cluster_client("t2")
+        t1.create("clusters.cluster.example.dev", new_cluster("east"))
+        # t2 has NO clusters
+        splitter = DeploymentSplitter(mc)
+        await splitter.start()
+        t1.create(DEPLOYMENTS, deployment("a", 4))
+        t2.create(DEPLOYMENTS, deployment("a", 4))
+        await eventually(lambda: t1.get(DEPLOYMENTS, "a--east", "default"))
+        # t2's deployment must not split into t1's cluster
+        await eventually(
+            lambda: (t2.get(DEPLOYMENTS, "a", "default").get("status", {}).get("conditions")
+                     or [{}])[0].get("reason") == "NoRegisteredClusters"
+        )
+        items, _ = t2.list(DEPLOYMENTS)
+        assert [o["metadata"]["name"] for o in items] == ["a"]
+        await splitter.stop()
+    asyncio.run(main())
+
+
+def test_rebalance_mode_adapts_to_cluster_changes():
+    async def main():
+        store = LogicalStore()
+        mc = MultiClusterClient(store)
+        t = mc.cluster_client("t")
+        t.create("clusters.cluster.example.dev", new_cluster("east"))
+        splitter = DeploymentSplitter(mc, rebalance=True)
+        await splitter.start()
+        t.create(DEPLOYMENTS, deployment("web", 6))
+        await eventually(
+            lambda: t.get(DEPLOYMENTS, "web--east", "default")["spec"]["replicas"] == 6
+        )
+        # a second cluster arrives: replicas re-split 3/3
+        t.create("clusters.cluster.example.dev", new_cluster("west"))
+        await eventually(
+            lambda: t.get(DEPLOYMENTS, "web--west", "default")["spec"]["replicas"] == 3
+            and t.get(DEPLOYMENTS, "web--east", "default")["spec"]["replicas"] == 3
+        )
+        await splitter.stop()
+    asyncio.run(main())
