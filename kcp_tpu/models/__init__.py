@@ -1,0 +1,3 @@
+from .reconcile_model import ReconcileDeltas, ReconcileModel, ReconcileState, reconcile_step
+
+__all__ = ["ReconcileModel", "ReconcileState", "ReconcileDeltas", "reconcile_step"]

@@ -1,0 +1,17 @@
+from .mesh import (
+    TENANTS_AXIS,
+    SLOTS_AXIS,
+    make_mesh,
+    shard_state,
+    state_sharding_tree,
+    state_shardings,
+)
+
+__all__ = [
+    "make_mesh",
+    "state_shardings",
+    "state_sharding_tree",
+    "shard_state",
+    "TENANTS_AXIS",
+    "SLOTS_AXIS",
+]

@@ -1,0 +1,112 @@
+"""Device meshes and shardings for the batched control plane.
+
+The scale dimension of this framework is object-count x logical-cluster
+count (SURVEY.md §5 "long-context" analog): the reconcile batch is a
+[B, S] mirror where B spans every object of every tenant. Sharding
+follows the scaling-book recipe — pick a mesh, annotate shardings, let
+XLA insert the collectives:
+
+- ``tenants`` axis (the data-parallel analog): rows are range-sharded, so
+  each device owns a contiguous block of tenants' objects. All row-local
+  math (diff lanes, scatter, placement) needs no communication.
+- ``slots`` axis (the tensor-parallel analog): the slot/column dimension
+  is sharded for very wide buckets; the diff's any-over-slots reduction
+  then runs as a partial reduce + XLA-inserted all-reduce over ``slots``
+  (riding ICI, never DCN, because slots is the minor mesh axis).
+
+Global convergence statistics (dirty counts, decision histograms) are
+full reductions; under jit with these shardings XLA lowers them to
+psum-style collectives across both axes.
+
+Multi-host: the same mesh spans hosts (jax.distributed); tenants-axis
+blocks map to hosts so informer-delta ingestion stays host-local and
+only the scalar stats cross DCN.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+TENANTS_AXIS = "tenants"
+SLOTS_AXIS = "slots"
+
+
+def make_mesh(
+    n_devices: int | None = None,
+    tenants: int | None = None,
+    slots: int = 1,
+    devices: list | None = None,
+) -> Mesh:
+    """A 2D (tenants, slots) mesh over the first ``n_devices`` devices.
+
+    ``slots=1`` (the default) keeps all sharding on the tenants axis —
+    the right choice until buckets grow past a few hundred slots.
+    """
+    devs = devices if devices is not None else jax.devices()
+    if n_devices is not None:
+        if len(devs) < n_devices:
+            raise ValueError(
+                f"requested {n_devices} devices but only {len(devs)} available"
+            )
+        devs = devs[:n_devices]
+    n = len(devs)
+    if tenants is None:
+        tenants = n // slots
+    if tenants * slots != n:
+        raise ValueError(f"mesh {tenants}x{slots} != {n} devices")
+    arr = np.array(devs).reshape(tenants, slots)
+    return Mesh(arr, (TENANTS_AXIS, SLOTS_AXIS))
+
+
+def state_shardings(mesh: Mesh) -> dict[str, NamedSharding]:
+    """NamedShardings for the reconcile state pytree (models/reconcile_model).
+
+    rows [B, S]    -> (tenants, slots)
+    flags [B]      -> (tenants,)
+    slot masks [S] -> (slots,)
+    placement [R,*]-> (tenants, ...)
+    selector [C]   -> replicated (every device matches its rows against
+                      every cluster selector)
+    """
+    def s(*spec):
+        return NamedSharding(mesh, P(*spec))
+
+    return {
+        "rows": s(TENANTS_AXIS, SLOTS_AXIS),
+        "flags": s(TENANTS_AXIS),
+        "slot_mask": s(SLOTS_AXIS),
+        "placement": s(TENANTS_AXIS, None),
+        "placement_rows": s(TENANTS_AXIS),
+        "labels": s(TENANTS_AXIS, None),
+        "selectors": s(),
+        "replicated": s(),
+    }
+
+
+def state_sharding_tree(mesh: Mesh):
+    """A ReconcileState pytree of NamedShardings — THE single source of
+    truth for how reconcile state is laid out on a mesh (used by
+    shard_state, jit out_shardings, and the sharding tests)."""
+    from ..models.reconcile_model import ReconcileState
+
+    sh = state_shardings(mesh)
+    return ReconcileState(
+        up_vals=sh["rows"],
+        up_exists=sh["flags"],
+        down_vals=sh["rows"],
+        down_exists=sh["flags"],
+        status_mask=sh["slot_mask"],
+        replicas=sh["placement_rows"],
+        avail=sh["placement"],
+        current=sh["placement"],
+        pair_hashes=sh["labels"],
+        sel_hashes=sh["selectors"],
+    )
+
+
+def shard_state(state, mesh: Mesh):
+    """device_put a ReconcileState pytree with the canonical shardings."""
+    tree = state_sharding_tree(mesh)
+    return jax.tree.map(jax.device_put, state, tree)
