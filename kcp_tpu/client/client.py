@@ -20,6 +20,7 @@ from ..apis.scheme import GVR, Scheme, default_scheme
 from ..store.selectors import LabelSelector
 from ..store.store import WILDCARD, LogicalStore, Watch
 from ..utils.errors import InvalidError
+from ..utils.routing import resolve_write_cluster
 
 
 def _resource(gvr: GVR | str) -> str:
@@ -62,14 +63,7 @@ class Client:
     # -- writes --------------------------------------------------------
 
     def _write_cluster(self, obj: dict) -> str:
-        if self.cluster != WILDCARD:
-            return self.cluster
-        cluster = (obj.get("metadata") or {}).get("clusterName")
-        if not cluster:
-            raise InvalidError(
-                "wildcard client write requires metadata.clusterName routing"
-            )
-        return cluster
+        return resolve_write_cluster(self.cluster, obj)
 
     def create(self, gvr: GVR | str, obj: dict, namespace: str = "") -> dict:
         return self._store.create(_resource(gvr), self._write_cluster(obj), obj, namespace)

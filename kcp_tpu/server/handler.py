@@ -1,0 +1,302 @@
+"""REST request routing: Kubernetes-style paths over the LogicalStore.
+
+Implements the HTTP surface of the reference's minimal apiserver
+(reference: pkg/server/server.go:145 CreateServerChain serves the generic
+control plane at :6443) with the fork's logical-cluster semantics:
+
+- ``/clusters/<name>`` path prefix or ``X-Kubernetes-Cluster`` header
+  selects the tenant; ``*`` reads across all tenants
+  (reference: server.go:164; docs/investigations/logical-clusters.md:70-74)
+- writes against the wildcard route to the logical cluster named in
+  ``metadata.clusterName`` — the fork's multi-cluster write routing
+  (reference call site: clientutils.EnableMultiCluster, server.go:230)
+- discovery (``/api``, ``/apis``, per-group resource lists), CRUD,
+  ``/status`` subresource, and ``?watch=true`` chunked event streams with
+  ``labelSelector`` / ``resourceVersion`` parameters.
+"""
+
+from __future__ import annotations
+
+import json
+
+from ..apis.scheme import GVR, ResourceInfo, Scheme
+from ..store.selectors import parse_selector
+from ..store.store import WILDCARD, LogicalStore
+from ..utils import errors
+from ..utils.routing import resolve_write_cluster
+from .httpd import Request, Response, StreamResponse
+
+DEFAULT_CLUSTER = "admin"
+CLUSTER_HEADER = "x-kubernetes-cluster"
+
+
+def _status_body(code: int, reason: str, message: str) -> dict:
+    return {
+        "kind": "Status",
+        "apiVersion": "v1",
+        "status": "Failure" if code >= 400 else "Success",
+        "reason": reason,
+        "message": message,
+        "code": code,
+    }
+
+
+def _error_response(err: errors.ApiError) -> Response:
+    return Response.of_json(_status_body(err.code, err.reason, err.message), err.code)
+
+
+class RestHandler:
+    """Routes parsed HTTP requests onto a LogicalStore + Scheme."""
+
+    def __init__(self, store: LogicalStore, scheme: Scheme,
+                 version_info: dict | None = None):
+        self.store = store
+        self.scheme = scheme
+        self.version_info = version_info or {"major": "0", "minor": "1",
+                                             "gitVersion": "kcp-tpu-v0.1.0"}
+        # /readyz gate: flipped by Server once post-start hooks complete
+        # (reference: the apiserver's readiness reflects post-start hooks,
+        # server.go:179-256)
+        self.ready = False
+
+    # ------------------------------------------------------------- routing
+
+    async def __call__(self, req: Request) -> Response | StreamResponse:
+        segs = [s for s in req.path.split("/") if s]
+        cluster = req.headers.get(CLUSTER_HEADER, DEFAULT_CLUSTER)
+        if len(segs) >= 2 and segs[0] == "clusters":
+            cluster = segs[1]
+            segs = segs[2:]
+        if not segs:
+            return Response.of_json({"paths": ["/api", "/apis", "/healthz", "/version"]})
+        head = segs[0]
+        if head == "healthz" or head == "livez":
+            return Response(body=b"ok", content_type="text/plain")
+        if head == "readyz":
+            if self.ready:
+                return Response(body=b"ok", content_type="text/plain")
+            return Response(status=500, body=b"not ready", content_type="text/plain")
+        if head == "version":
+            return Response.of_json(self.version_info)
+        if head == "api":
+            return await self._route_group(req, cluster, group="", segs=segs[1:])
+        if head == "apis":
+            return await self._route_apis(req, cluster, segs[1:])
+        return _error_response(errors.NotFoundError(f"unknown path {req.path}"))
+
+    async def _route_apis(self, req: Request, cluster: str, segs: list[str]):
+        if not segs:
+            groups = []
+            for group, versions in sorted(self.scheme.group_versions().items()):
+                if not group:
+                    continue
+                vs = sorted(versions)
+                groups.append({
+                    "name": group,
+                    "versions": [{"groupVersion": f"{group}/{v}", "version": v} for v in vs],
+                    "preferredVersion": {"groupVersion": f"{group}/{vs[0]}", "version": vs[0]},
+                })
+            return Response.of_json({"kind": "APIGroupList", "apiVersion": "v1",
+                                     "groups": groups})
+        group, segs = segs[0], segs[1:]
+        return await self._route_group(req, cluster, group, segs)
+
+    async def _route_group(self, req: Request, cluster: str, group: str, segs: list[str]):
+        if not segs:
+            if group == "":
+                return Response.of_json({"kind": "APIVersions", "versions": ["v1"]})
+            versions = sorted(self.scheme.group_versions().get(group, ()))
+            if not versions:
+                return _error_response(errors.NotFoundError(f"unknown group {group}"))
+            return Response.of_json({
+                "kind": "APIGroup", "apiVersion": "v1", "name": group,
+                "versions": [{"groupVersion": f"{group}/{v}", "version": v} for v in versions],
+            })
+        version, segs = segs[0], segs[1:]
+        if not segs:
+            return self._discovery(group, version)
+
+        # path shapes (after group/version):
+        #   <resource>[/<name>[/status]]                      cluster-scoped
+        #   namespaces/<ns>/<resource>[/<name>[/status]]      namespaced
+        namespace = ""
+        if segs[0] == "namespaces" and len(segs) >= 3:
+            namespace = segs[1]
+            segs = segs[2:]
+        resource, segs = segs[0], segs[1:]
+        name = segs[0] if segs else None
+        subresource = segs[1] if len(segs) > 1 else None
+        if len(segs) > 2 or subresource not in (None, "status"):
+            return _error_response(errors.NotFoundError(f"unknown path {req.path}"))
+
+        info = self._resolve(group, version, resource)
+        if info is None:
+            return _error_response(
+                errors.NotFoundError(f"the server could not find the requested "
+                                     f"resource {resource} in {group}/{version}"))
+        try:
+            return await self._serve_resource(req, cluster, info, namespace, name, subresource)
+        except errors.ApiError as e:
+            return _error_response(e)
+
+    def _resolve(self, group: str, version: str, resource: str) -> ResourceInfo | None:
+        info = self.scheme.by_resource(GVR(group, version, resource).storage_name)
+        if info is not None and info.gvr.version != version:
+            return None
+        return info
+
+    def _discovery(self, group: str, version: str) -> Response:
+        resources = []
+        for info in self.scheme.all():
+            if info.gvr.group != group or info.gvr.version != version:
+                continue
+            resources.append({
+                "name": info.gvr.resource, "singularName": info.singular,
+                "kind": info.kind, "namespaced": info.namespaced,
+                "verbs": ["create", "delete", "get", "list", "update", "watch"],
+            })
+            if info.has_status:
+                resources.append({
+                    "name": f"{info.gvr.resource}/status", "singularName": "",
+                    "kind": info.kind, "namespaced": info.namespaced,
+                    "verbs": ["get", "update"],
+                })
+        if not resources:
+            return _error_response(errors.NotFoundError(f"unknown group/version {group}/{version}"))
+        gv = f"{group}/{version}" if group else version
+        return Response.of_json({"kind": "APIResourceList", "apiVersion": "v1",
+                                 "groupVersion": gv, "resources": resources})
+
+    # ---------------------------------------------------------- resources
+
+    async def _serve_resource(self, req: Request, cluster: str, info: ResourceInfo,
+                              namespace: str, name: str | None, subresource: str | None):
+        res = info.gvr.storage_name
+        gv = f"{info.gvr.group}/{info.gvr.version}" if info.gvr.group else info.gvr.version
+
+        if req.method == "GET":
+            if name is None:
+                if req.param("watch") in ("true", "1"):
+                    return self._watch(req, cluster, res, namespace or None)
+                selector = parse_selector(req.param("labelSelector"))
+                items, rv = self.store.list(res, cluster, namespace or None, selector)
+                return Response.of_json({
+                    "kind": info.list_kind, "apiVersion": gv,
+                    "metadata": {"resourceVersion": str(rv)},
+                    "items": items,
+                })
+            obj = self.store.get(res, self._read_cluster(cluster, res, name, namespace),
+                                 name, namespace)
+            return Response.of_json(self._stamp(obj, info, gv))
+
+        if req.method == "POST" and name is None:
+            obj = self._body_object(req)
+            target = resolve_write_cluster(cluster, obj, errors.BadRequestError)
+            created = self.store.create(res, target, obj, namespace)
+            return Response.of_json(self._stamp(created, info, gv), 201)
+
+        if req.method == "PUT" and name is not None:
+            obj = self._body_object(req)
+            body_name = obj.setdefault("metadata", {}).setdefault("name", name)
+            if body_name != name:
+                raise errors.BadRequestError(
+                    f"name in URL ({name}) does not match name in object ({body_name})")
+            target = resolve_write_cluster(cluster, obj, errors.BadRequestError)
+            if subresource == "status":
+                updated = self.store.update_status(res, target, obj, namespace)
+            else:
+                updated = self.store.update(res, target, obj, namespace)
+            return Response.of_json(self._stamp(updated, info, gv))
+
+        if req.method == "DELETE" and name is not None:
+            target = self._read_cluster(cluster, res, name, namespace)
+            self.store.delete(res, target, name, namespace)
+            return Response.of_json(_status_body(200, "Deleted", f"{res} {name} deleted"))
+
+        raise errors.BadRequestError(f"unsupported method {req.method} for {req.path}")
+
+    @staticmethod
+    def _body_object(req: Request) -> dict:
+        try:
+            obj = req.json()
+        except ValueError as e:
+            raise errors.BadRequestError(f"malformed JSON body: {e}") from e
+        if not isinstance(obj, dict):
+            raise errors.BadRequestError("body must be a JSON object")
+        return obj
+
+    def _stamp(self, obj: dict, info: ResourceInfo, gv: str) -> dict:
+        obj.setdefault("kind", info.kind)
+        obj.setdefault("apiVersion", gv)
+        return obj
+
+    def _read_cluster(self, cluster: str, res: str, name: str, namespace: str) -> str:
+        """Wildcard single-object reads scan tenants for the unique owner."""
+        if cluster != WILDCARD:
+            return cluster
+        matches = [c for c in self.store.clusters()
+                   if self._exists(res, c, name, namespace)]
+        if len(matches) == 1:
+            return matches[0]
+        if not matches:
+            raise errors.NotFoundError(f"{res} {namespace}/{name} not found in any cluster")
+        raise errors.BadRequestError(
+            f"{res} {namespace}/{name} is ambiguous across clusters {matches}")
+
+    def _exists(self, res: str, cluster: str, name: str, namespace: str) -> bool:
+        try:
+            self.store.get(res, cluster, name, namespace)
+            return True
+        except errors.NotFoundError:
+            return False
+
+    # -------------------------------------------------------------- watch
+
+    def _watch(self, req: Request, cluster: str, res: str,
+               namespace: str | None) -> StreamResponse:
+        selector = parse_selector(req.param("labelSelector"))
+        since = req.param("resourceVersion")
+        try:
+            since_rv = int(since) if since else None
+        except ValueError as e:
+            raise errors.BadRequestError(f"malformed resourceVersion {since!r}") from e
+
+        async def produce(stream: StreamResponse) -> None:
+            try:
+                watch = self.store.watch(res, cluster, namespace, selector, since_rv)
+            except errors.ConflictError as e:
+                # expired watch window → 410 Gone in-stream, like the
+                # apiserver's "too old resource version"
+                await stream.send_json({"type": "ERROR",
+                                        "object": _status_body(410, "Expired", e.message)})
+                return
+            try:
+                async for ev in watch:
+                    await stream.send_json({"type": ev.type, "object": ev.object})
+            finally:
+                watch.close()
+
+        return StreamResponse(produce)
+
+
+def render_kubeconfig(address: str, path: str) -> None:
+    """Write an admin kubeconfig-style file with admin + user contexts.
+
+    Mirrors the reference writing .kcp/admin.kubeconfig with contexts
+    ``admin`` and ``user`` (the latter scoped to /clusters/user)
+    (reference: pkg/server/server.go:151-176).
+    """
+    cfg = {
+        "kind": "Config", "apiVersion": "v1",
+        "clusters": [
+            {"name": "admin", "cluster": {"server": address}},
+            {"name": "user", "cluster": {"server": f"{address}/clusters/user"}},
+        ],
+        "contexts": [
+            {"name": "admin", "context": {"cluster": "admin"}},
+            {"name": "user", "context": {"cluster": "user"}},
+        ],
+        "current-context": "admin",
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(cfg, f, indent=2)

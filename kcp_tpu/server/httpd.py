@@ -1,0 +1,214 @@
+"""Minimal asyncio HTTP/1.1 server for the control-plane REST surface.
+
+Only what the API surface needs: request-line + header parsing,
+Content-Length bodies, one-shot JSON responses, and chunked streaming
+responses for watches. No TLS (the reference's self-signed-cert etcd/
+serving setup, pkg/etcd/etcd.go:98-188, is an operational concern that a
+fronting proxy covers here; the wire protocol is the interesting part).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from dataclasses import dataclass, field
+from typing import Awaitable, Callable
+from urllib.parse import parse_qs, unquote, urlsplit
+
+log = logging.getLogger(__name__)
+
+MAX_HEADER_BYTES = 64 * 1024
+MAX_BODY_BYTES = 16 * 1024 * 1024
+
+
+@dataclass
+class Request:
+    method: str
+    path: str
+    query: dict[str, list[str]]
+    headers: dict[str, str]  # keys lower-cased
+    body: bytes
+
+    def param(self, name: str, default: str | None = None) -> str | None:
+        vals = self.query.get(name)
+        return vals[0] if vals else default
+
+    def json(self):
+        if not self.body:
+            return None
+        return json.loads(self.body)
+
+
+@dataclass
+class Response:
+    status: int = 200
+    body: bytes = b""
+    content_type: str = "application/json"
+    headers: dict[str, str] = field(default_factory=dict)
+
+    @classmethod
+    def of_json(cls, obj, status: int = 200) -> "Response":
+        return cls(status=status, body=json.dumps(obj).encode())
+
+
+class StreamResponse:
+    """A chunked-transfer streaming response (the watch wire format).
+
+    The handler returns one of these; the connection loop then calls
+    :meth:`send_json` per event until the producer finishes or the client
+    disconnects.
+    """
+
+    def __init__(self, producer: Callable[["StreamResponse"], Awaitable[None]],
+                 status: int = 200):
+        self.status = status
+        self.producer = producer
+        self._writer: asyncio.StreamWriter | None = None
+
+    async def _begin(self, writer: asyncio.StreamWriter) -> None:
+        self._writer = writer
+        writer.write(
+            f"HTTP/1.1 {self.status} {_reason(self.status)}\r\n"
+            "Content-Type: application/json\r\n"
+            "Transfer-Encoding: chunked\r\n"
+            "Connection: close\r\n\r\n".encode()
+        )
+        await writer.drain()
+
+    async def send_json(self, obj) -> None:
+        assert self._writer is not None
+        data = json.dumps(obj).encode() + b"\n"
+        self._writer.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+        await self._writer.drain()
+
+    async def _finish(self) -> None:
+        if self._writer is not None:
+            try:
+                self._writer.write(b"0\r\n\r\n")
+                await self._writer.drain()
+            except (ConnectionError, RuntimeError):
+                pass
+
+
+_REASONS = {200: "OK", 201: "Created", 400: "Bad Request", 404: "Not Found",
+            405: "Method Not Allowed", 409: "Conflict", 410: "Gone",
+            422: "Unprocessable Entity", 500: "Internal Server Error"}
+
+
+def _reason(status: int) -> str:
+    return _REASONS.get(status, "Unknown")
+
+
+Handler = Callable[[Request], Awaitable["Response | StreamResponse"]]
+
+
+class HttpServer:
+    """asyncio.start_server wrapper dispatching to a single handler."""
+
+    def __init__(self, handler: Handler, host: str = "127.0.0.1", port: int = 0):
+        self.handler = handler
+        self.host = host
+        self.port = port
+        self._server: asyncio.AbstractServer | None = None
+        self._conns: set[asyncio.Task] = set()
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(self._serve, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        log.info("http server listening on %s:%d", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            # long-lived watch streams never finish on their own — cancel
+            # them or wait_closed() blocks forever
+            for task in list(self._conns):
+                task.cancel()
+            await asyncio.gather(*self._conns, return_exceptions=True)
+            await self._server.wait_closed()
+            self._server = None
+
+    @property
+    def address(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    async def _serve(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        task = asyncio.current_task()
+        if task is not None:
+            self._conns.add(task)
+            task.add_done_callback(self._conns.discard)
+        try:
+            while True:
+                req = await self._read_request(reader)
+                if req is None:
+                    break
+                try:
+                    resp = await self.handler(req)
+                except Exception:  # handler bug — surface as 500, keep serving
+                    log.exception("handler error for %s %s", req.method, req.path)
+                    resp = Response.of_json(
+                        {"kind": "Status", "status": "Failure",
+                         "reason": "InternalError", "code": 500}, 500)
+                if isinstance(resp, StreamResponse):
+                    await resp._begin(writer)
+                    try:
+                        await resp.producer(resp)
+                    except (ConnectionError, asyncio.CancelledError):
+                        pass
+                    await resp._finish()
+                    break  # streams always close the connection
+                keep = req.headers.get("connection", "keep-alive") != "close"
+                head = (
+                    f"HTTP/1.1 {resp.status} {_reason(resp.status)}\r\n"
+                    f"Content-Type: {resp.content_type}\r\n"
+                    f"Content-Length: {len(resp.body)}\r\n"
+                )
+                for k, v in resp.headers.items():
+                    head += f"{k}: {v}\r\n"
+                head += f"Connection: {'keep-alive' if keep else 'close'}\r\n\r\n"
+                writer.write(head.encode() + resp.body)
+                await writer.drain()
+                if not keep:
+                    break
+        except (ConnectionError, asyncio.IncompleteReadError, asyncio.LimitOverrunError):
+            pass
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except (ConnectionError, RuntimeError):
+                pass
+
+    async def _read_request(self, reader: asyncio.StreamReader) -> Request | None:
+        try:
+            head = await reader.readuntil(b"\r\n\r\n")
+        except (asyncio.IncompleteReadError, ConnectionError):
+            return None
+        if len(head) > MAX_HEADER_BYTES:
+            return None
+        lines = head.decode("latin-1").split("\r\n")
+        try:
+            method, target, _version = lines[0].split(" ", 2)
+        except ValueError:
+            return None
+        headers: dict[str, str] = {}
+        for line in lines[1:]:
+            if not line:
+                continue
+            k, _, v = line.partition(":")
+            headers[k.strip().lower()] = v.strip()
+        body = b""
+        clen = int(headers.get("content-length", "0") or "0")
+        if clen:
+            if clen > MAX_BODY_BYTES:
+                return None
+            body = await reader.readexactly(clen)
+        parts = urlsplit(target)
+        return Request(
+            method=method.upper(),
+            path=unquote(parts.path),
+            query=parse_qs(parts.query),
+            headers=headers,
+            body=body,
+        )

@@ -1,0 +1,385 @@
+"""RestClient: the Client interface spoken over HTTP.
+
+The out-of-process analog of the reference's client-go REST clients: the
+standalone binaries (cluster-controller, syncer, deployment-splitter,
+crd-puller — reference cmd/*/main.go) connect to a kcp server with a
+kubeconfig; here they construct a RestClient against the server address.
+Implements the same interface as :class:`kcp_tpu.client.Client`, so every
+controller runs equally in-process (store-backed) or remote (HTTP).
+
+Watch streams are chunked-transfer JSON lines (see server.handler._watch);
+RestWatch reassembles them into store Events so the shared Informer works
+unchanged over the wire.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import http.client
+import json
+from typing import Iterable
+from urllib.parse import quote, urlsplit
+
+from ..apis.scheme import GVR, ResourceInfo, Scheme, default_scheme
+from ..store.selectors import LabelSelector
+from ..store.store import WILDCARD, Event
+from ..utils import errors
+from ..utils.routing import resolve_write_cluster
+
+
+def _raise_for_status(code: int, body: bytes) -> None:
+    if code < 400:
+        return
+    try:
+        status = json.loads(body)
+    except (ValueError, UnicodeDecodeError):
+        status = {}
+    message = status.get("message", body.decode("latin-1")[:200])
+    reason = status.get("reason", "")
+    by_reason = {
+        "NotFound": errors.NotFoundError,
+        "AlreadyExists": errors.AlreadyExistsError,
+        "Conflict": errors.ConflictError,
+        "Invalid": errors.InvalidError,
+        "BadRequest": errors.BadRequestError,
+    }
+    cls = by_reason.get(reason)
+    if cls is None:
+        cls = {404: errors.NotFoundError, 409: errors.ConflictError,
+               422: errors.InvalidError, 400: errors.BadRequestError}.get(
+                   code, errors.ApiError)
+    raise cls(message)
+
+
+class RestWatch:
+    """Async iterator over a server watch stream, yielding store Events.
+
+    Duck-types the parts of :class:`kcp_tpu.store.store.Watch` that
+    informers and syncers use: ``async for``, :meth:`next_batch`,
+    :meth:`drain`, :meth:`close`.
+    """
+
+    def __init__(self, host: str, port: int, path: str, resource: str):
+        self._host = host
+        self._port = port
+        self._path = path
+        self.resource = resource
+        self._events: asyncio.Queue[Event | None] = asyncio.Queue()
+        self._task: asyncio.Task | None = None
+        self._closed = False
+        self.error: Exception | None = None  # set on non-2xx watch responses
+
+    def _ensure_started(self) -> None:
+        if self._task is None and not self._closed:
+            self._task = asyncio.ensure_future(self._run())
+
+    async def _run(self) -> None:
+        reader = writer = None
+        try:
+            reader, writer = await asyncio.open_connection(self._host, self._port)
+            writer.write(
+                f"GET {self._path} HTTP/1.1\r\nHost: {self._host}\r\n"
+                "Connection: close\r\n\r\n".encode())
+            await writer.drain()
+            head = await reader.readuntil(b"\r\n\r\n")
+            status_line = head.split(b"\r\n", 1)[0].decode("latin-1")
+            code = int(status_line.split(" ")[1])
+            if code >= 400:
+                body = await reader.read(64 * 1024)
+                # strip chunked framing if present; _raise_for_status just
+                # needs the JSON Status body
+                try:
+                    _raise_for_status(code, body[body.find(b"{"):body.rfind(b"}") + 1])
+                except errors.ApiError as e:
+                    self.error = e
+                return
+            buf = b""
+            while True:
+                size_line = await reader.readline()
+                if not size_line:
+                    break
+                size = int(size_line.strip() or b"0", 16)
+                if size == 0:
+                    break
+                chunk = await reader.readexactly(size)
+                await reader.readexactly(2)  # trailing \r\n
+                buf += chunk
+                while b"\n" in buf:
+                    line, buf = buf.split(b"\n", 1)
+                    if line.strip():
+                        self._handle_line(json.loads(line))
+        except (ConnectionError, asyncio.IncompleteReadError, OSError,
+                ValueError, IndexError):
+            pass  # connection died or stream garbled → clean end-of-stream
+        finally:
+            if writer is not None:
+                writer.close()
+            self._closed = True
+            self._events.put_nowait(None)
+
+    def _handle_line(self, msg: dict) -> None:
+        if msg.get("type") == "ERROR":
+            # 410 Gone — watch window expired; consumer must re-list
+            self._closed = True
+            self._events.put_nowait(None)
+            return
+        obj = msg["object"]
+        meta = obj.get("metadata") or {}
+        self._events.put_nowait(Event(
+            type=msg["type"],
+            resource=self.resource,
+            cluster=meta.get("clusterName", ""),
+            namespace=meta.get("namespace", ""),
+            name=meta.get("name", ""),
+            object=obj,
+            rv=int(meta.get("resourceVersion", "0")),
+        ))
+
+    def __aiter__(self) -> "RestWatch":
+        self._ensure_started()
+        return self
+
+    async def __anext__(self) -> Event:
+        self._ensure_started()
+        if self._closed and self._events.empty():
+            self._raise_if_error()
+            raise StopAsyncIteration
+        ev = await self._events.get()
+        if ev is None:
+            # keep the sentinel so repeated iteration keeps terminating
+            self._events.put_nowait(None)
+            self._raise_if_error()
+            raise StopAsyncIteration
+        return ev
+
+    def _raise_if_error(self) -> None:
+        if self.error is not None:
+            err, self.error = self.error, None
+            raise err
+
+    async def next_batch(self, max_wait: float = 0.05) -> list[Event]:
+        self._ensure_started()
+        out: list[Event] = []
+        if self._closed and self._events.empty():
+            self._raise_if_error()
+            return out
+        try:
+            ev = await asyncio.wait_for(self._events.get(), timeout=max_wait)
+            if ev is None:
+                self._events.put_nowait(None)
+                self._raise_if_error()
+                return out
+            out.append(ev)
+        except asyncio.TimeoutError:
+            return out
+        out.extend(self.drain())
+        return out
+
+    def drain(self) -> list[Event]:
+        out: list[Event] = []
+        while not self._events.empty():
+            ev = self._events.get_nowait()
+            if ev is None:
+                self._events.put_nowait(None)
+                break
+            out.append(ev)
+        return out
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def close(self) -> None:
+        self._closed = True
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+
+class RestClient:
+    """HTTP twin of :class:`kcp_tpu.client.Client`."""
+
+    def __init__(self, base_url: str, cluster: str = "admin",
+                 scheme: Scheme | None = None):
+        parts = urlsplit(base_url)
+        self._host = parts.hostname or "127.0.0.1"
+        self._port = parts.port or 80
+        self.base_url = base_url.rstrip("/")
+        self.cluster = cluster
+        self.scheme = scheme if scheme is not None else default_scheme()
+        self._discovered: dict[str, ResourceInfo] = {}
+        self._conn: http.client.HTTPConnection | None = None
+
+    def scoped(self, cluster: str) -> "RestClient":
+        c = RestClient(self.base_url, cluster, self.scheme)
+        c._discovered = self._discovered
+        return c
+
+    # ------------------------------------------------------------ plumbing
+
+    def _request(self, method: str, path: str, body: dict | None = None) -> dict | None:
+        """One request over a kept-alive connection; reconnect once on error."""
+        payload = json.dumps(body).encode() if body is not None else None
+        headers = {"Content-Type": "application/json"} if payload else {}
+        for attempt in (0, 1):
+            if self._conn is None:
+                self._conn = http.client.HTTPConnection(
+                    self._host, self._port, timeout=30)
+            try:
+                self._conn.request(method, path, body=payload, headers=headers)
+                resp = self._conn.getresponse()
+                data = resp.read()
+            except (ConnectionError, http.client.HTTPException, OSError):
+                self._conn.close()
+                self._conn = None
+                if attempt:
+                    raise
+                continue
+            _raise_for_status(resp.status, data)
+            return json.loads(data) if data else None
+        return None  # unreachable
+
+    def close(self) -> None:
+        if self._conn is not None:
+            self._conn.close()
+            self._conn = None
+
+    def _resolve(self, resource: str) -> ResourceInfo:
+        info = self.scheme.by_resource(resource) or self._discovered.get(resource)
+        if info is not None:
+            return info
+        self._refresh_discovery()
+        info = self._discovered.get(resource)
+        if info is None:
+            raise errors.NotFoundError(f"resource {resource} not served")
+        return info
+
+    def _refresh_discovery(self) -> None:
+        """Populate the resource→GVR map from /api + /apis discovery."""
+        gvs: list[tuple[str, str]] = [("", "v1")]
+        groups = self._request("GET", "/apis") or {}
+        for g in groups.get("groups", []):
+            for v in g.get("versions", []):
+                gvs.append((g["name"], v["version"]))
+        for group, version in gvs:
+            prefix = f"/apis/{group}/{version}" if group else f"/api/{version}"
+            try:
+                rlist = self._request("GET", prefix) or {}
+            except errors.ApiError:
+                continue
+            for r in rlist.get("resources", []):
+                if "/" in r["name"]:
+                    continue
+                gvr = GVR(group, version, r["name"])
+                self._discovered[gvr.storage_name] = ResourceInfo(
+                    gvr=gvr, kind=r["kind"], list_kind=r["kind"] + "List",
+                    singular=r.get("singularName") or r["kind"].lower(),
+                    namespaced=bool(r.get("namespaced")),
+                )
+
+    def _path(self, resource: str, namespace: str | None, name: str | None = None,
+              subresource: str | None = None, cluster: str | None = None,
+              query: str = "") -> str:
+        info = self._resolve(resource)
+        gvr = info.gvr
+        prefix = f"/apis/{gvr.group}/{gvr.version}" if gvr.group else f"/api/{gvr.version}"
+        p = f"/clusters/{quote(cluster or self.cluster, safe='*')}" + prefix
+        if namespace:
+            p += f"/namespaces/{namespace}"
+        p += f"/{gvr.resource}"
+        if name:
+            p += f"/{name}"
+        if subresource:
+            p += f"/{subresource}"
+        if query:
+            p += "?" + query
+        return p
+
+    @staticmethod
+    def _resource_name(gvr: GVR | str) -> str:
+        return gvr.storage_name if isinstance(gvr, GVR) else gvr
+
+    # -------------------------------------------------------------- reads
+
+    def get(self, gvr: GVR | str, name: str, namespace: str = "") -> dict:
+        res = self._resource_name(gvr)
+        return self._request("GET", self._path(res, namespace, name))
+
+    def list(self, gvr: GVR | str, namespace: str | None = None,
+             selector: LabelSelector | None = None) -> tuple[list[dict], int]:
+        res = self._resource_name(gvr)
+        query = ""
+        if selector is not None and not selector.empty:
+            query = "labelSelector=" + quote(str(selector))
+        body = self._request("GET", self._path(res, namespace, query=query))
+        rv = int((body.get("metadata") or {}).get("resourceVersion", "0"))
+        return body.get("items", []), rv
+
+    def watch(self, gvr: GVR | str, namespace: str | None = None,
+              selector: LabelSelector | None = None,
+              since_rv: int | None = None) -> RestWatch:
+        res = self._resource_name(gvr)
+        query = "watch=true"
+        if selector is not None and not selector.empty:
+            query += "&labelSelector=" + quote(str(selector))
+        if since_rv is not None:
+            query += f"&resourceVersion={since_rv}"
+        path = self._path(res, namespace, query=query)
+        return RestWatch(self._host, self._port, path, res)
+
+    # ------------------------------------------------------------- writes
+
+    def _write_cluster(self, obj: dict) -> str:
+        return resolve_write_cluster(self.cluster, obj)
+
+    def create(self, gvr: GVR | str, obj: dict, namespace: str = "") -> dict:
+        res = self._resource_name(gvr)
+        namespace = namespace or (obj.get("metadata") or {}).get("namespace", "")
+        return self._request(
+            "POST", self._path(res, namespace, cluster=self._write_cluster(obj)), obj)
+
+    def update(self, gvr: GVR | str, obj: dict, namespace: str = "") -> dict:
+        res = self._resource_name(gvr)
+        meta = obj.get("metadata") or {}
+        namespace = namespace or meta.get("namespace", "")
+        return self._request(
+            "PUT",
+            self._path(res, namespace, meta["name"], cluster=self._write_cluster(obj)),
+            obj)
+
+    def update_status(self, gvr: GVR | str, obj: dict, namespace: str = "") -> dict:
+        res = self._resource_name(gvr)
+        meta = obj.get("metadata") or {}
+        namespace = namespace or meta.get("namespace", "")
+        return self._request(
+            "PUT",
+            self._path(res, namespace, meta["name"], "status",
+                       cluster=self._write_cluster(obj)),
+            obj)
+
+    def delete(self, gvr: GVR | str, name: str, namespace: str = "",
+               cluster: str | None = None) -> None:
+        res = self._resource_name(gvr)
+        target = cluster or self.cluster
+        if target == WILDCARD:
+            raise errors.InvalidError("wildcard delete requires an explicit cluster")
+        self._request("DELETE", self._path(res, namespace, name, cluster=target))
+
+    # ---------------------------------------------------------- discovery
+
+    def resources(self) -> list[str]:
+        self._refresh_discovery()
+        return sorted(set(self._discovered) |
+                      {i.gvr.storage_name for i in self.scheme.all()})
+
+
+class MultiClusterRestClient(RestClient):
+    """Wildcard RestClient (EnableMultiCluster analog over the wire)."""
+
+    def __init__(self, base_url: str, resources: Iterable[str] | None = None,
+                 scheme: Scheme | None = None):
+        super().__init__(base_url, WILDCARD, scheme)
+        self._enabled = set(resources) if resources is not None else None
+
+    def cluster_client(self, cluster: str) -> RestClient:
+        return self.scoped(cluster)

@@ -1,0 +1,129 @@
+"""Server core: wires storage + REST surface + in-process controllers.
+
+The analog of the reference's pkg/server/server.go:79-292: create the
+data dir, bring up storage (WAL-backed LogicalStore standing in for
+embedded etcd, reference pkg/etcd/etcd.go), serve the REST API, write
+admin.kubeconfig (server.go:151-176), then fire post-start hooks that
+install the in-process controllers (the "Install Cluster Controller"
+hook, server.go:193-255).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+from dataclasses import dataclass, field
+
+from ..apis.scheme import Scheme, default_scheme
+from ..client import MultiClusterClient
+from ..physical import PhysicalRegistry
+from ..store import LogicalStore
+from .handler import RestHandler, render_kubeconfig
+from .httpd import HttpServer
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class Config:
+    """Server configuration (reference: pkg/server/config.go:13-42)."""
+
+    root_dir: str = ".kcp_tpu"
+    listen_host: str = "127.0.0.1"
+    listen_port: int = 0  # 0 = ephemeral (reference default is 6443)
+    durable: bool = True  # WAL-backed store vs in-memory
+    install_controllers: bool = True  # in-proc controllers (kcp start default)
+    auto_publish_apis: bool = False  # --auto_publish_apis flag analog
+    resources_to_sync: list[str] = field(default_factory=lambda: ["deployments.apps"])
+    syncer_mode: str = "push"  # push | pull | none (controller.go:42-48)
+    poll_interval: float = 15.0
+    import_poll_interval: float = 15.0
+
+
+class Server:
+    """One kcp-tpu control-plane process."""
+
+    def __init__(self, config: Config | None = None, scheme: Scheme | None = None,
+                 registry: PhysicalRegistry | None = None):
+        self.config = config or Config()
+        self.scheme = scheme or default_scheme()
+        self.registry = registry or PhysicalRegistry()
+        wal = None
+        if self.config.durable:
+            os.makedirs(self.config.root_dir, exist_ok=True)
+            wal = os.path.join(self.config.root_dir, "store.wal")
+        self.store = LogicalStore(wal_path=wal)
+        self.handler = RestHandler(self.store, self.scheme)
+        self.http = HttpServer(self.handler, self.config.listen_host,
+                               self.config.listen_port)
+        self.client = MultiClusterClient(self.store)
+        self._controllers: list = []
+        self._post_start_hooks: list = []
+        self._stop = asyncio.Event()
+
+    def add_post_start_hook(self, hook) -> None:
+        """Register an async callable fired once serving (server.go:294-312)."""
+        self._post_start_hooks.append(hook)
+
+    @property
+    def address(self) -> str:
+        return self.http.address
+
+    async def start(self) -> None:
+        """Bring the server up and fire hooks; returns once serving."""
+        await self.http.start()
+        if self.config.durable:
+            render_kubeconfig(self.address,
+                              os.path.join(self.config.root_dir, "admin.kubeconfig"))
+        if self.config.install_controllers:
+            await self._install_controllers()
+        for hook in self._post_start_hooks:
+            await hook(self)
+        self.handler.ready = True
+        log.info("kcp-tpu serving at %s", self.address)
+
+    async def _install_controllers(self) -> None:
+        """The "Install Cluster Controller" post-start hook
+        (reference: server.go:193-255 — cluster controller Start(2),
+        apiresource controller Start(2), plus CRD lifecycle which the
+        reference gets from its forked apiextensions apiserver)."""
+        from ..reconcilers.apiresource import NegotiationController
+        from ..reconcilers.cluster import ClusterController, SyncerMode
+        from ..reconcilers.crdlifecycle import CRDLifecycleController
+        from ..reconcilers.deployment import DeploymentSplitter
+
+        mode = {"push": SyncerMode.PUSH, "pull": SyncerMode.PULL,
+                "none": SyncerMode.NONE}[self.config.syncer_mode]
+        self._controllers = [
+            NegotiationController(self.client,
+                                  auto_publish=self.config.auto_publish_apis),
+            CRDLifecycleController(self.client),
+            ClusterController(
+                self.client, self.registry,
+                resources_to_sync=self.config.resources_to_sync,
+                mode=mode, poll_interval=self.config.poll_interval,
+                import_poll_interval=self.config.import_poll_interval,
+            ),
+            DeploymentSplitter(self.client),
+        ]
+        for c in self._controllers:
+            await c.start()
+
+    async def run(self) -> None:
+        """start() then block until stop() (reference: server.go:258-260)."""
+        await self.start()
+        await self._stop.wait()
+        await self.shutdown()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    async def shutdown(self) -> None:
+        for c in reversed(self._controllers):
+            await c.stop()
+        self._controllers = []
+        await self.http.stop()
+        if self.config.durable:
+            self.store.snapshot()
+        self.store.close()

@@ -1,0 +1,347 @@
+"""API server tests: REST surface, tenant routing, watch streams, RestClient.
+
+Covers the behavior the reference gets from pkg/server + the forked
+apiserver (SURVEY.md §1 layer 2): /clusters/<name> routing, wildcard
+reads, write routing by metadata.clusterName, discovery, the status
+subresource, optimistic concurrency over the wire, and chunked watch
+streams consumed by the shared Informer.
+
+The server runs on its own thread/loop (ServerThread) and tests talk to
+it over real HTTP — the same process split as the reference's standalone
+binaries vs `kcp start`.
+"""
+
+import asyncio
+import http.client
+import json
+
+import pytest
+
+from kcp_tpu.client import Informer
+from kcp_tpu.server import Config, MultiClusterRestClient, RestClient, Server
+from kcp_tpu.server.threaded import ServerThread
+from kcp_tpu.utils import errors
+
+
+@pytest.fixture()
+def srv():
+    with ServerThread(Config(durable=False, install_controllers=False)) as st:
+        yield st
+
+
+def raw_request(st, method, path, body=None):
+    conn = http.client.HTTPConnection("127.0.0.1", st.server.http.port, timeout=10)
+    try:
+        payload = json.dumps(body).encode() if body is not None else None
+        conn.request(method, path, body=payload)
+        resp = conn.getresponse()
+        data = resp.read()
+        return resp.status, json.loads(data) if data.startswith(b"{") else data
+    finally:
+        conn.close()
+
+
+def cm(name, data, ns="default", cluster=None, labels=None):
+    meta = {"name": name, "namespace": ns}
+    if cluster:
+        meta["clusterName"] = cluster
+    if labels:
+        meta["labels"] = labels
+    return {"apiVersion": "v1", "kind": "ConfigMap", "metadata": meta, "data": data}
+
+
+# ---------------------------------------------------------------- raw HTTP
+
+
+def test_health_version_discovery(srv):
+    status, body = raw_request(srv, "GET", "/healthz")
+    assert (status, body) == (200, b"ok")
+    status, body = raw_request(srv, "GET", "/version")
+    assert status == 200 and body["gitVersion"].startswith("kcp-tpu")
+    status, body = raw_request(srv, "GET", "/api/v1")
+    assert status == 200
+    names = {r["name"] for r in body["resources"]}
+    assert {"configmaps", "namespaces", "configmaps/status"} <= names
+    status, body = raw_request(srv, "GET", "/apis")
+    groups = {g["name"] for g in body["groups"]}
+    assert {"apps", "cluster.example.dev", "apiresource.kcp.dev"} <= groups
+    status, body = raw_request(srv, "GET", "/apis/apps/v1")
+    assert {r["name"] for r in body["resources"]} >= {"deployments"}
+
+
+def test_crud_roundtrip_and_tenant_routing(srv):
+    status, created = raw_request(
+        srv, "POST", "/clusters/alpha/api/v1/namespaces/default/configmaps",
+        cm("a", {"k": "1"}))
+    assert status == 201
+    assert created["metadata"]["clusterName"] == "alpha"
+    assert created["kind"] == "ConfigMap"
+
+    # same name in tenant beta is independent (logical-cluster isolation)
+    status, _ = raw_request(
+        srv, "POST", "/clusters/beta/api/v1/namespaces/default/configmaps",
+        cm("a", {"k": "2"}))
+    assert status == 201
+
+    status, got = raw_request(
+        srv, "GET", "/clusters/alpha/api/v1/namespaces/default/configmaps/a")
+    assert status == 200 and got["data"] == {"k": "1"}
+
+    # wildcard list spans tenants
+    status, lst = raw_request(srv, "GET", "/clusters/*/api/v1/configmaps")
+    assert status == 200 and len(lst["items"]) == 2
+    assert lst["kind"] == "ConfigMapList"
+    assert int(lst["metadata"]["resourceVersion"]) > 0
+
+    # tenant-scoped list does not
+    status, lst = raw_request(srv, "GET", "/clusters/beta/api/v1/configmaps")
+    assert len(lst["items"]) == 1 and lst["items"][0]["data"] == {"k": "2"}
+
+    status, _ = raw_request(
+        srv, "DELETE", "/clusters/alpha/api/v1/namespaces/default/configmaps/a")
+    assert status == 200
+    status, _ = raw_request(
+        srv, "GET", "/clusters/alpha/api/v1/namespaces/default/configmaps/a")
+    assert status == 404
+
+
+def test_wildcard_write_routes_by_cluster_name(srv):
+    # fork semantics: writes to * route by metadata.clusterName
+    status, _ = raw_request(
+        srv, "POST", "/clusters/*/api/v1/namespaces/default/configmaps",
+        cm("routed", {"x": "y"}, cluster="gamma"))
+    assert status == 201
+    status, got = raw_request(
+        srv, "GET", "/clusters/gamma/api/v1/namespaces/default/configmaps/routed")
+    assert status == 200 and got["data"] == {"x": "y"}
+    status, body = raw_request(
+        srv, "POST", "/clusters/*/api/v1/namespaces/default/configmaps",
+        cm("nope", {}))
+    assert status == 400 and body["reason"] == "BadRequest"
+
+
+def test_status_subresource_and_conflict(srv):
+    path = "/clusters/t/apis/cluster.example.dev/v1alpha1/clusters"
+    obj = {"metadata": {"name": "c1"}, "spec": {"kubeconfig": "fake://c1"}}
+    status, created = raw_request(srv, "POST", path, obj)
+    assert status == 201
+    gen0 = created["metadata"]["generation"]
+
+    # status write does not bump generation
+    created["status"] = {"phase": "Ready"}
+    status, updated = raw_request(srv, "PUT", path + "/c1/status", created)
+    assert status == 200
+    assert updated["status"] == {"phase": "Ready"}
+    assert updated["metadata"]["generation"] == gen0
+
+    # stale RV conflicts
+    stale = dict(updated)
+    stale["metadata"] = dict(
+        updated["metadata"], resourceVersion=created["metadata"]["resourceVersion"])
+    stale["spec"] = {"kubeconfig": "fake://other"}
+    status, body = raw_request(srv, "PUT", path + "/c1", stale)
+    assert status == 409 and body["reason"] == "Conflict"
+
+    # spec write through the main resource does not clobber status
+    fresh = raw_request(srv, "GET", path + "/c1")[1]
+    fresh["spec"] = {"kubeconfig": "fake://new"}
+    fresh.pop("status")
+    status, updated2 = raw_request(srv, "PUT", path + "/c1", fresh)
+    assert status == 200
+    assert updated2["status"] == {"phase": "Ready"}
+    assert updated2["metadata"]["generation"] == gen0 + 1
+
+
+def test_unknown_resource_404(srv):
+    status, body = raw_request(srv, "GET", "/clusters/t/apis/nope/v1/widgets")
+    assert status == 404 and body["reason"] == "NotFound"
+
+
+def test_client_errors_are_4xx(srv):
+    # malformed JSON body → 400, not 500
+    conn = http.client.HTTPConnection("127.0.0.1", srv.server.http.port, timeout=10)
+    conn.request("POST", "/clusters/t/api/v1/configmaps", body=b"not json")
+    resp = conn.getresponse()
+    assert resp.status == 400
+    resp.read()
+    conn.close()
+
+    # PUT body name must match URL name
+    raw_request(srv, "POST", "/clusters/t/api/v1/namespaces/d/configmaps", cm("x", {}))
+    status, body = raw_request(
+        srv, "PUT", "/clusters/t/api/v1/namespaces/d/configmaps/x", cm("y", {}, ns="d"))
+    assert status == 400 and "does not match" in body["message"]
+
+    # malformed watch resourceVersion → 400
+    status, _ = raw_request(
+        srv, "GET", "/clusters/t/api/v1/configmaps?watch=true&resourceVersion=abc")
+    assert status == 400
+
+    # readyz reflects completed startup
+    status, body = raw_request(srv, "GET", "/readyz")
+    assert (status, body) == (200, b"ok")
+
+
+def test_rest_watch_unknown_resource_raises(srv):
+    """A watch on an unserved resource surfaces NotFound, not silence."""
+
+    async def main():
+        w = RestClient(srv.address, cluster="t")
+        from kcp_tpu.apis.scheme import GVR, ResourceInfo, Scheme
+
+        sch = Scheme()
+        sch.register(ResourceInfo(GVR("ghost.dev", "v1", "ghosts"), "Ghost",
+                                  "GhostList", "ghost", True))
+        watch = RestClient(srv.address, cluster="t", scheme=sch).watch("ghosts.ghost.dev")
+        with pytest.raises(errors.NotFoundError):
+            async for _ in watch:
+                pass
+        assert watch.closed
+
+    asyncio.run(main())
+
+
+def test_server_thread_startup_failure_propagates():
+    with ServerThread(Config(durable=False, install_controllers=False)) as st:
+        port = st.server.http.port
+        with pytest.raises(RuntimeError) as exc_info:
+            ServerThread(Config(durable=False, install_controllers=False,
+                                listen_port=port)).start()
+        assert "startup failed" in str(exc_info.value)
+
+
+def test_watch_stream_over_http(srv):
+    """A raw chunked watch delivers ADDED events as JSON lines."""
+
+    async def main():
+        port = srv.server.http.port
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        writer.write(
+            b"GET /clusters/t/api/v1/configmaps?watch=true HTTP/1.1\r\n"
+            b"Host: x\r\nConnection: close\r\n\r\n")
+        await writer.drain()
+        await reader.readuntil(b"\r\n\r\n")
+
+        # mutate through the API (on the server's own loop/thread)
+        await asyncio.to_thread(
+            raw_request, srv, "POST",
+            "/clusters/t/api/v1/namespaces/default/configmaps", cm("w1", {"a": "b"}))
+
+        size = int((await reader.readline()).strip(), 16)
+        chunk = await reader.readexactly(size)
+        msg = json.loads(chunk)
+        assert msg["type"] == "ADDED"
+        assert msg["object"]["metadata"]["name"] == "w1"
+        writer.close()
+
+    asyncio.run(main())
+
+
+# --------------------------------------------------------------- RestClient
+
+
+def test_rest_client_crud(srv):
+    c = RestClient(srv.address, cluster="alpha")
+    created = c.create("configmaps", cm("rc", {"v": "1"}))
+    assert created["metadata"]["clusterName"] == "alpha"
+
+    got = c.get("configmaps", "rc", "default")
+    assert got["data"] == {"v": "1"}
+
+    got["data"] = {"v": "2"}
+    updated = c.update("configmaps", got)
+    assert updated["data"] == {"v": "2"}
+
+    items, rv = c.list("configmaps")
+    assert len(items) == 1 and rv > 0
+
+    with pytest.raises(errors.ConflictError):
+        stale = dict(updated)
+        stale["metadata"] = dict(
+            updated["metadata"], resourceVersion=created["metadata"]["resourceVersion"])
+        c.update("configmaps", stale)
+
+    c.delete("configmaps", "rc", "default")
+    with pytest.raises(errors.NotFoundError):
+        c.get("configmaps", "rc", "default")
+
+
+def test_rest_client_discovery_of_dynamic_resource(srv):
+    """Resources registered after startup (CRD publication) are discovered."""
+    from kcp_tpu.apis.scheme import GVR, ResourceInfo, Scheme
+
+    srv.call(srv.server.scheme.register, ResourceInfo(
+        gvr=GVR("widgets.example.dev", "v1", "widgets"), kind="Widget",
+        list_kind="WidgetList", singular="widget", namespaced=True))
+    c = RestClient(srv.address, cluster="t", scheme=Scheme())
+    obj = c.create("widgets.widgets.example.dev",
+                   {"metadata": {"name": "w", "namespace": "ns1"}, "spec": {"n": 1}})
+    assert obj["kind"] == "Widget"
+    assert "widgets.widgets.example.dev" in c.resources()
+
+
+def test_informer_over_rest_watch(srv):
+    """The shared Informer runs unchanged over the HTTP watch stream."""
+
+    async def main():
+        mc = MultiClusterRestClient(srv.address)
+        inf = Informer(mc, "configmaps")
+        seen = []
+        inf.add_handler(
+            lambda et, old, new: seen.append((et, (new or old)["metadata"]["name"])))
+        # list() inside start() is blocking HTTP — fine here: the server
+        # answers from its own thread
+        await inf.start()
+        await inf.wait_synced()
+
+        await asyncio.to_thread(
+            raw_request, srv, "POST",
+            "/clusters/a/api/v1/namespaces/default/configmaps", cm("i1", {"z": "1"}))
+        await asyncio.to_thread(
+            raw_request, srv, "POST",
+            "/clusters/b/api/v1/namespaces/default/configmaps", cm("i2", {"z": "2"}))
+
+        for _ in range(200):
+            if len(seen) >= 2:
+                break
+            await asyncio.sleep(0.02)
+        assert {n for _, n in seen} == {"i1", "i2"}
+        assert inf.get("a", "i1", "default")["data"] == {"z": "1"}
+        await inf.stop()
+
+    asyncio.run(main())
+
+
+def test_watch_window_expired_gone(srv):
+    """Resuming from a pre-compaction RV yields an in-stream 410 ERROR."""
+    for i in range(5):
+        raw_request(srv, "POST",
+                    "/clusters/t/api/v1/namespaces/default/configmaps", cm(f"g{i}", {}))
+    # simulate compaction: blow away retained history (on the server thread)
+    srv.call(srv.server.store._history.clear)
+    raw_request(srv, "POST",
+                "/clusters/t/api/v1/namespaces/default/configmaps", cm("last", {}))
+
+    async def main():
+        w = RestClient(srv.address, cluster="t").watch("configmaps", since_rv=1)
+        batch = await w.next_batch(max_wait=2.0)
+        assert batch == [] and w.closed
+        w.close()
+
+    asyncio.run(main())
+
+
+# ------------------------------------------------------------ server core
+
+
+def test_server_durable_restart(tmp_path):
+    cfg = Config(root_dir=str(tmp_path), durable=True, install_controllers=False)
+    with ServerThread(cfg) as st:
+        c = RestClient(st.address, cluster="t")
+        c.create("configmaps", cm("persist", {"k": "v"}))
+        assert (tmp_path / "admin.kubeconfig").exists()
+
+    with ServerThread(Config(root_dir=str(tmp_path), durable=True,
+                             install_controllers=False)) as st2:
+        got = RestClient(st2.address, cluster="t").get("configmaps", "persist", "default")
+        assert got["data"] == {"k": "v"}
