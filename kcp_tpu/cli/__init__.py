@@ -1,0 +1,11 @@
+"""kcp_tpu.cli — the CLI binaries (reference: cmd/).
+
+Each module is runnable with ``python -m kcp_tpu.cli.<name>``:
+
+- ``kcp``                  the control-plane server (cmd/kcp)
+- ``cluster_controller``   standalone controllers (cmd/cluster-controller)
+- ``syncer``               standalone spec/status syncer (cmd/syncer)
+- ``deployment_splitter``  standalone splitter (cmd/deployment-splitter)
+- ``crd_puller``           dump cluster APIs as CRD YAML (cmd/crd-puller)
+- ``compat``               CRD schema compat / LCD check (cmd/compat)
+"""

@@ -1,0 +1,104 @@
+"""``kcp start`` — run the control-plane server process.
+
+The analog of the reference's cmd/kcp/kcp.go:15-63 (`kcp start` cobra
+command): bring up storage + API server + in-process controllers and
+serve until interrupted. Flags mirror pkg/server/config.go:45-112.
+
+Usage:
+    python -m kcp_tpu.cli.kcp start [--listen-port 6443] [--root-dir .kcp_tpu]
+        [--in-memory] [--no-install-controllers] [--auto-publish-apis]
+        [--resources-to-sync deployments.apps] [--syncer-mode push|pull|none]
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import signal
+import sys
+
+from ..server import Config, Server
+from .help import fit_terminal, parser
+
+DOC = """Start a kcp-tpu control plane: a minimal multi-tenant API server
+serving many logical clusters from one store, with batched TPU-backed
+reconcilers installed in-process.
+
+kcp-tpu is a TPU-native re-design of the kcp prototype: per-tenant
+reconcile loops run as vectorized JAX programs instead of one goroutine
+per workspace."""
+
+
+def build_parser():
+    p = parser("kcp", DOC)
+    sub = p.add_subparsers(dest="command", required=True)
+    start = sub.add_parser("start", help="start the control plane",
+                           description=fit_terminal(DOC))
+    start.add_argument("--listen-host", default="127.0.0.1")
+    start.add_argument("--listen-port", type=int, default=6443,
+                       help="API port (reference default :6443)")
+    start.add_argument("--root-dir", default=".kcp_tpu",
+                       help="data directory (reference: .kcp/, server.go:80-94)")
+    start.add_argument("--in-memory", action="store_true",
+                       help="no WAL durability (testing)")
+    start.add_argument("--no-install-controllers", action="store_true",
+                       help="serve only; controllers run out-of-process "
+                            "(reference: cmd/cluster-controller)")
+    start.add_argument("--auto-publish-apis", action="store_true",
+                       help="negotiated APIs publish without manual approval "
+                            "(reference: --auto_publish_apis)")
+    start.add_argument("--resources-to-sync", default="deployments.apps",
+                       help="comma-separated resources synced to physical clusters")
+    start.add_argument("--syncer-mode", choices=["push", "pull", "none"],
+                       default="push")
+    start.add_argument("--poll-interval", type=float, default=60.0,
+                       help="cluster health/API-import poll seconds "
+                            "(reference: cluster.go:22, apiimporter.go:37)")
+    start.add_argument("-v", "--verbosity", type=int, default=0)
+    return p
+
+
+def config_from_args(args) -> Config:
+    return Config(
+        root_dir=args.root_dir,
+        listen_host=args.listen_host,
+        listen_port=args.listen_port,
+        durable=not args.in_memory,
+        install_controllers=not args.no_install_controllers,
+        auto_publish_apis=args.auto_publish_apis,
+        resources_to_sync=[r for r in args.resources_to_sync.split(",") if r],
+        syncer_mode=args.syncer_mode,
+        poll_interval=args.poll_interval,
+        import_poll_interval=args.poll_interval,
+    )
+
+
+async def serve(config: Config) -> None:
+    server = Server(config)
+
+    async def announce(s: Server) -> None:
+        # parseable by wrapping scripts (the reference prints the admin
+        # kubeconfig path at startup for the same purpose)
+        print(f"kcp-tpu serving at {s.address}", flush=True)
+
+    server.add_post_start_hook(announce)
+    loop = asyncio.get_event_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, server.stop)
+        except NotImplementedError:  # non-unix
+            pass
+    await server.run()
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbosity > 0 else logging.INFO,
+        format="%(asctime)s %(levelname).1s %(name)s: %(message)s")
+    asyncio.run(serve(config_from_args(args)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

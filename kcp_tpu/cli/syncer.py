@@ -1,0 +1,78 @@
+"""Standalone syncer — spec↔status sync between two API servers.
+
+The analog of the reference's cmd/syncer/main.go:24-73: connect upstream
+(kcp, filtered to one logical cluster) and downstream (physical cluster),
+then run the batched spec-downsync + status-upsync engine for the listed
+resource types. In the reference this binary is what pull-mode deploys
+into each physical cluster.
+
+Usage:
+    python -m kcp_tpu.cli.syncer --from-server http://kcp:6443 \
+        --from-cluster tenant-a --to-server http://physical:8080 \
+        --cluster us-east1 deployments.apps configmaps
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import signal
+import sys
+
+from ..server.rest import RestClient
+from .help import parser
+
+DOC = """Sync specs down from a kcp-tpu logical cluster to a physical
+cluster and statuses back up, for the listed resource types. Objects are
+selected by the kcp.dev/cluster=<cluster> label; sync decisions are
+computed by the batched TPU diff kernel."""
+
+
+def build_parser():
+    p = parser("syncer", DOC)
+    p.add_argument("--from-server", required=True,
+                   help="upstream kcp-tpu URL (reference: -from_kubeconfig)")
+    p.add_argument("--from-cluster", default="admin",
+                   help="upstream logical cluster name")
+    p.add_argument("--to-server", required=True,
+                   help="downstream physical cluster URL (reference: "
+                        "-to_kubeconfig / in-cluster config)")
+    p.add_argument("--to-cluster", default="default",
+                   help="downstream tenant (physical servers are usually "
+                        "single-tenant: 'default')")
+    p.add_argument("--cluster", required=True,
+                   help="sync target id — the kcp.dev/cluster label value "
+                        "(reference: -cluster)")
+    p.add_argument("--backend", choices=["tpu", "host"], default="tpu")
+    p.add_argument("resources", nargs="+",
+                   help="resource types to sync, e.g. deployments.apps")
+    return p
+
+
+async def run(args) -> None:
+    from ..syncer import start_syncer
+
+    upstream = RestClient(args.from_server, cluster=args.from_cluster)
+    downstream = RestClient(args.to_server, cluster=args.to_cluster)
+    syncer = await start_syncer(upstream, downstream, args.resources,
+                                args.cluster, backend=args.backend)
+    stop = asyncio.Event()
+    loop = asyncio.get_event_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except NotImplementedError:
+            pass
+    await stop.wait()
+    await syncer.stop()
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    asyncio.run(run(args))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,8 +14,6 @@ over HTTP, so controllers run equally in-process or remote.
 
 from __future__ import annotations
 
-from typing import Iterable
-
 from ..apis.scheme import GVR, Scheme, default_scheme
 from ..store.selectors import LabelSelector
 from ..store.store import WILDCARD, LogicalStore, Watch
@@ -100,11 +98,8 @@ class MultiClusterClient(Client):
     ``metadata.clusterName``.
     """
 
-    def __init__(self, store: LogicalStore, resources: Iterable[str] | None = None):
+    def __init__(self, store: LogicalStore):
         super().__init__(store, WILDCARD)
-        # resources argument kept for parity with EnableMultiCluster's
-        # explicit resource list; the dict store needs no per-resource setup
-        self._enabled = set(resources) if resources is not None else None
 
     def cluster_client(self, cluster: str) -> Client:
         # share the scheme: CRD registrations must be visible to every view

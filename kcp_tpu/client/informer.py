@@ -78,6 +78,8 @@ class Informer:
         self._task: asyncio.Task | None = None
         self._resync_task: asyncio.Task | None = None
         self._watch = None
+        self._stopping = False
+        self.rewatch_backoff = 0.2  # reflector retry pacing on stream loss
 
     # ------------------------------------------------------------ wiring
 
@@ -166,9 +168,42 @@ class Informer:
             self._resync_task = asyncio.create_task(self._resync_loop())
 
     async def _pump(self) -> None:
+        """Dispatch watch events; on unexpected stream end, re-list + re-watch.
+
+        The reflector loop of client-go: an in-process store Watch only
+        ends when closed, but a REST watch ends on connection drop or an
+        expired watch window (410). Without this, an HTTP-connected
+        controller would silently run against a frozen cache forever.
+        """
         assert self._watch is not None
-        async for ev in self._watch:
-            self._dispatch(ev)
+        while True:
+            try:
+                async for ev in self._watch:
+                    self._dispatch(ev)
+            except Exception:  # noqa: BLE001 — expired window / transport error
+                log.warning("informer %s: watch failed; re-listing", self.gvr,
+                            exc_info=True)
+            if self._stopping:
+                return
+            await asyncio.sleep(self.rewatch_backoff)
+            try:
+                rv = self._relist()
+                self._watch = self.client.watch(
+                    self.gvr, self.namespace, self.selector, since_rv=rv)
+            except Exception:  # noqa: BLE001 — server still down; retry
+                log.warning("informer %s: re-list failed; retrying", self.gvr,
+                            exc_info=True)
+
+    def _relist(self) -> int:
+        """Fresh list reconciled against the cache (replace semantics)."""
+        items, rv = self.client.list(self.gvr, self.namespace, self.selector)
+        fresh = {self._key(o): o for o in items}
+        for key, old in list(self.cache.items()):
+            if key not in fresh:
+                self._apply(DELETED, old)
+        for key, obj in fresh.items():
+            self._apply(MODIFIED if key in self.cache else ADDED, obj)
+        return rv
 
     def _dispatch(self, ev: Event) -> None:
         self._apply(ev.type, ev.object)
@@ -191,6 +226,7 @@ class Informer:
         return self._synced.is_set()
 
     async def stop(self) -> None:
+        self._stopping = True
         for t in (self._task, self._resync_task):
             if t is not None:
                 t.cancel()

@@ -174,6 +174,12 @@ class RestHandler:
         res = info.gvr.storage_name
         gv = f"{info.gvr.group}/{info.gvr.version}" if info.gvr.group else info.gvr.version
 
+        if subresource == "status" and req.method not in ("GET", "PUT"):
+            # discovery advertises get+update only; a DELETE here must not
+            # silently remove the whole object
+            raise errors.BadRequestError(
+                "the status subresource supports get and update only")
+
         if req.method == "GET":
             if name is None:
                 if req.param("watch") in ("true", "1"):

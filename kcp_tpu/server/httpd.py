@@ -152,10 +152,19 @@ class HttpServer:
                          "reason": "InternalError", "code": 500}, 500)
                 if isinstance(resp, StreamResponse):
                     await resp._begin(writer)
+                    # watch the socket for client disconnect: an idle stream
+                    # never writes, so EOF would otherwise go unnoticed and
+                    # the producer (and its store subscription) would leak
+                    monitor = asyncio.ensure_future(reader.read(1))
+                    producer = asyncio.ensure_future(resp.producer(resp))
                     try:
-                        await resp.producer(resp)
-                    except (ConnectionError, asyncio.CancelledError):
-                        pass
+                        await asyncio.wait({monitor, producer},
+                                           return_when=asyncio.FIRST_COMPLETED)
+                    finally:
+                        for t in (monitor, producer):
+                            t.cancel()
+                        await asyncio.gather(monitor, producer,
+                                             return_exceptions=True)
                     await resp._finish()
                     break  # streams always close the connection
                 keep = req.headers.get("connection", "keep-alive") != "close"

@@ -17,7 +17,6 @@ from __future__ import annotations
 import asyncio
 import http.client
 import json
-from typing import Iterable
 from urllib.parse import quote, urlsplit
 
 from ..apis.scheme import GVR, ResourceInfo, Scheme, default_scheme
@@ -119,7 +118,11 @@ class RestWatch:
 
     def _handle_line(self, msg: dict) -> None:
         if msg.get("type") == "ERROR":
-            # 410 Gone — watch window expired; consumer must re-list
+            # 410 Gone — watch window expired. Surface it the way the
+            # in-process Watch does (ConflictError) so consumers know to
+            # re-list instead of treating this as a benign close.
+            self.error = errors.ConflictError(
+                (msg.get("object") or {}).get("message", "watch window expired"))
             self._closed = True
             self._events.put_nowait(None)
             return
@@ -218,23 +221,39 @@ class RestClient:
     # ------------------------------------------------------------ plumbing
 
     def _request(self, method: str, path: str, body: dict | None = None) -> dict | None:
-        """One request over a kept-alive connection; reconnect once on error."""
+        """One request over a kept-alive connection.
+
+        Retry discipline: a send-stage failure on a *reused* connection is
+        the classic stale-keep-alive case and is safe to retry for any
+        method (the request never reached the server). A failure while
+        reading the response is only retried for GET — the server may have
+        already committed a POST/PUT/DELETE, and re-sending would duplicate
+        the write.
+        """
         payload = json.dumps(body).encode() if body is not None else None
         headers = {"Content-Type": "application/json"} if payload else {}
         for attempt in (0, 1):
+            reused = self._conn is not None
             if self._conn is None:
                 self._conn = http.client.HTTPConnection(
                     self._host, self._port, timeout=30)
             try:
                 self._conn.request(method, path, body=payload, headers=headers)
+            except (ConnectionError, http.client.HTTPException, OSError):
+                self._conn.close()
+                self._conn = None
+                if reused and attempt == 0:
+                    continue
+                raise
+            try:
                 resp = self._conn.getresponse()
                 data = resp.read()
             except (ConnectionError, http.client.HTTPException, OSError):
                 self._conn.close()
                 self._conn = None
-                if attempt:
-                    raise
-                continue
+                if method == "GET" and attempt == 0:
+                    continue
+                raise
             _raise_for_status(resp.status, data)
             return json.loads(data) if data else None
         return None  # unreachable
@@ -376,10 +395,8 @@ class RestClient:
 class MultiClusterRestClient(RestClient):
     """Wildcard RestClient (EnableMultiCluster analog over the wire)."""
 
-    def __init__(self, base_url: str, resources: Iterable[str] | None = None,
-                 scheme: Scheme | None = None):
+    def __init__(self, base_url: str, scheme: Scheme | None = None):
         super().__init__(base_url, WILDCARD, scheme)
-        self._enabled = set(resources) if resources is not None else None
 
     def cluster_client(self, cluster: str) -> RestClient:
         return self.scoped(cluster)

@@ -1,0 +1,198 @@
+"""CLI binary tests (reference: cmd/* — SURVEY.md §2.1 rows for the six
+binaries). The kcp server binary is exercised as a real subprocess with
+REST CRUD against it; compat and crd-puller run in-process via main().
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import yaml
+
+from kcp_tpu.cli import compat as compat_cli
+from kcp_tpu.cli import crd_puller as puller_cli
+from kcp_tpu.cli.help import fit_terminal
+from kcp_tpu.cli.kcp import build_parser, config_from_args
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def crd_yaml(tmp_path, name, replicas_type):
+    crd = {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": "deployments.apps"},
+        "spec": {
+            "group": "apps",
+            "names": {"plural": "deployments", "kind": "Deployment"},
+            "versions": [{
+                "name": "v1", "served": True, "storage": True,
+                "schema": {"openAPIV3Schema": {
+                    "type": "object",
+                    "properties": {"spec": {"type": "object", "properties": {
+                        "replicas": {"type": replicas_type}}}},
+                }},
+            }],
+        },
+    }
+    p = tmp_path / name
+    p.write_text(yaml.safe_dump(crd))
+    return str(p)
+
+
+def test_help_fit_terminal():
+    text = "word " * 60 + "\n\n  indented code block"
+    out = fit_terminal(text, width=40)
+    lines = out.split("\n")
+    assert all(len(line) <= 40 for line in lines[:-1])
+    assert out.endswith("  indented code block")  # verbatim block preserved
+
+
+def test_kcp_flags_to_config():
+    args = build_parser().parse_args(
+        ["start", "--in-memory", "--listen-port", "7001",
+         "--resources-to-sync", "deployments.apps,configmaps",
+         "--syncer-mode", "none", "--auto-publish-apis"])
+    cfg = config_from_args(args)
+    assert not cfg.durable
+    assert cfg.listen_port == 7001
+    assert cfg.resources_to_sync == ["deployments.apps", "configmaps"]
+    assert cfg.syncer_mode == "none"
+    assert cfg.auto_publish_apis
+
+
+def test_compat_cli(tmp_path, capsys):
+    a = crd_yaml(tmp_path, "a.yaml", "integer")
+    b = crd_yaml(tmp_path, "b.yaml", "integer")
+    c = crd_yaml(tmp_path, "c.yaml", "string")
+
+    assert compat_cli.main([a, b]) == 0
+    assert "compatible" in capsys.readouterr().out
+
+    assert compat_cli.main([a, c]) == 1
+    assert "replicas" in capsys.readouterr().err
+
+    # --lcd on a property-removal case narrows and prints a schema
+    assert compat_cli.main([a, b, "--lcd"]) == 0
+    lcd = yaml.safe_load(capsys.readouterr().out)
+    assert lcd["properties"]["spec"]["properties"]["replicas"]["type"] == "integer"
+
+
+def test_crd_puller_cli(tmp_path, capsys):
+    """Pull a synthesized CRD from a live server over HTTP."""
+    from kcp_tpu.server import Config
+    from kcp_tpu.server.threaded import ServerThread
+
+    with ServerThread(Config(durable=False, install_controllers=False)) as st:
+        rc = puller_cli.main(["--server", st.address, "--cluster", "default",
+                              "--out-dir", str(tmp_path), "deployments.apps"])
+        assert rc == 0
+        out = yaml.safe_load((tmp_path / "deployments.apps.yaml").read_text())
+        assert out["kind"] == "CustomResourceDefinition"
+        assert out["spec"]["group"] == "apps"
+
+        rc = puller_cli.main(["--server", st.address, "--out-dir", str(tmp_path),
+                              "nonexistent.fake.group"])
+        assert rc == 1
+
+
+def _start_kcp(tmp_path, env, name):
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kcp_tpu.cli.kcp", "start",
+         "--in-memory", "--no-install-controllers", "--listen-port", "0"],
+        cwd=str(tmp_path), env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+    line = proc.stdout.readline()
+    assert "serving at" in line, f"{name}: {line}"
+    return proc, line.strip().rsplit(" ", 1)[-1]
+
+
+def test_three_process_sync_pipeline(tmp_path):
+    """kcp + physical cluster + standalone syncer as separate processes.
+
+    The reference's deployment story (SURVEY.md §3.3/3.4): a labeled
+    object created in a logical cluster downsyncs to the physical
+    cluster over real HTTP end to end.
+    """
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    procs = []
+    try:
+        kcp, kcp_url = _start_kcp(tmp_path, env, "kcp")
+        procs.append(kcp)
+        phys, phys_url = _start_kcp(tmp_path, env, "phys")
+        procs.append(phys)
+
+        syncer = subprocess.Popen(
+            [sys.executable, "-m", "kcp_tpu.cli.syncer",
+             "--from-server", kcp_url, "--from-cluster", "tenant",
+             "--to-server", phys_url, "--to-cluster", "default",
+             "--cluster", "east", "--backend", "host", "configmaps"],
+            cwd=str(tmp_path), env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, text=True)
+        procs.append(syncer)
+
+        obj = {"metadata": {"name": "synced-cm",
+                            "labels": {"kcp.dev/cluster": "east"}},
+               "data": {"from": "kcp"}}
+        req = urllib.request.Request(
+            f"{kcp_url}/clusters/tenant/api/v1/namespaces/default/configmaps",
+            data=json.dumps(obj).encode(), method="POST")
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert resp.status == 201
+
+        deadline = time.time() + 30
+        got = None
+        while time.time() < deadline:
+            if syncer.poll() is not None:
+                raise AssertionError(f"syncer died: {syncer.stderr.read()[-2000:]}")
+            try:
+                with urllib.request.urlopen(
+                        f"{phys_url}/clusters/default/api/v1/namespaces/default/"
+                        "configmaps/synced-cm", timeout=5) as resp:
+                    got = json.loads(resp.read())
+                break
+            except urllib.error.HTTPError:
+                time.sleep(0.2)
+        assert got is not None, "object never downsynced"
+        assert got["data"] == {"from": "kcp"}
+    finally:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            p.wait(timeout=15)
+
+
+def test_kcp_start_subprocess(tmp_path):
+    """`kcp start` as a real process: serves REST, shuts down on SIGTERM."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kcp_tpu.cli.kcp", "start",
+         "--in-memory", "--no-install-controllers", "--listen-port", "0"],
+        cwd=str(tmp_path), env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+    try:
+        line = proc.stdout.readline()
+        assert "serving at" in line, line
+        base = line.strip().rsplit(" ", 1)[-1]
+
+        body = json.dumps({"metadata": {"name": "sub"}, "data": {"a": "1"}}).encode()
+        req = urllib.request.Request(
+            f"{base}/clusters/t/api/v1/namespaces/default/configmaps",
+            data=body, method="POST")
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert resp.status == 201
+        with urllib.request.urlopen(
+                f"{base}/clusters/t/api/v1/namespaces/default/configmaps/sub",
+                timeout=10) as resp:
+            got = json.loads(resp.read())
+        assert got["data"] == {"a": "1"}
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=15) == 0

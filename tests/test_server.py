@@ -313,7 +313,8 @@ def test_informer_over_rest_watch(srv):
 
 
 def test_watch_window_expired_gone(srv):
-    """Resuming from a pre-compaction RV yields an in-stream 410 ERROR."""
+    """Resuming from a pre-compaction RV surfaces ConflictError (re-list),
+    matching the in-process Watch contract — not a silent clean close."""
     for i in range(5):
         raw_request(srv, "POST",
                     "/clusters/t/api/v1/namespaces/default/configmaps", cm(f"g{i}", {}))
@@ -324,9 +325,60 @@ def test_watch_window_expired_gone(srv):
 
     async def main():
         w = RestClient(srv.address, cluster="t").watch("configmaps", since_rv=1)
-        batch = await w.next_batch(max_wait=2.0)
-        assert batch == [] and w.closed
+        with pytest.raises(errors.ConflictError):
+            await w.next_batch(max_wait=2.0)
+        assert w.closed
         w.close()
+
+    asyncio.run(main())
+
+
+def test_delete_on_status_subresource_rejected(srv):
+    raw_request(srv, "POST", "/clusters/t/api/v1/namespaces/d/configmaps", cm("keep", {}))
+    status, _ = raw_request(
+        srv, "DELETE", "/clusters/t/api/v1/namespaces/d/configmaps/keep/status")
+    assert status == 400
+    status, _ = raw_request(
+        srv, "GET", "/clusters/t/api/v1/namespaces/d/configmaps/keep")
+    assert status == 200  # object untouched
+
+
+def test_informer_reconnects_after_server_restart(tmp_path):
+    """Reflector behavior: on server restart the informer re-lists and
+    keeps tracking new events instead of freezing on a dead stream."""
+
+    async def main():
+        cfg = Config(root_dir=str(tmp_path), durable=True,
+                     install_controllers=False, listen_port=0)
+        st = ServerThread(cfg).start()
+        port = st.server.http.port
+        c = RestClient(st.address, cluster="t")
+        c.create("configmaps", cm("before", {"k": "1"}))
+
+        inf = Informer(MultiClusterRestClient(st.address), "configmaps")
+        inf.rewatch_backoff = 0.05
+        await inf.start()
+        await inf.wait_synced()
+        assert inf.get("t", "before", "default") is not None
+
+        st.stop()
+        # give the pump a moment to notice the dead stream and start retrying
+        await asyncio.sleep(0.2)
+        st2 = ServerThread(Config(root_dir=str(tmp_path), durable=True,
+                                  install_controllers=False,
+                                  listen_port=port)).start()
+        try:
+            RestClient(st2.address, cluster="t").create(
+                "configmaps", cm("after", {"k": "2"}))
+            for _ in range(200):
+                if inf.get("t", "after", "default") is not None:
+                    break
+                await asyncio.sleep(0.05)
+            assert inf.get("t", "after", "default") is not None
+            assert inf.get("t", "before", "default") is not None
+            await inf.stop()
+        finally:
+            st2.stop()
 
     asyncio.run(main())
 
