@@ -68,7 +68,10 @@ async def run(args) -> None:
         DeploymentSplitter(client),
     ]
     for c in controllers:
-        await c.start()
+        if isinstance(c, (NegotiationController, ClusterController)):
+            await c.start(num_workers=args.num_threads)
+        else:
+            await c.start()
 
     stop = asyncio.Event()
     loop = asyncio.get_event_loop()

@@ -23,6 +23,8 @@ common denominator schema (narrowing the existing schema where needed)."""
 def _schema_of(crd: dict) -> dict:
     """First served version's openAPIV3Schema."""
     for v in crd.get("spec", {}).get("versions", []):
+        if not v.get("served", True):
+            continue
         schema = (v.get("schema") or {}).get("openAPIV3Schema")
         if schema:
             return schema

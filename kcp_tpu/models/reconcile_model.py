@@ -31,7 +31,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.diff import apply_deltas, sync_decisions
+from ..ops.diff import apply_deltas, compact_patches, sync_decisions
 from ..ops.labelmatch import fanout_match
 from ..ops.placement import placement_changed, split_replicas
 
@@ -56,23 +56,38 @@ class ReconcileState(NamedTuple):
 
 
 class ReconcileDeltas(NamedTuple):
-    """One tick's informer deltas, padded to a fixed D."""
+    """One tick's informer deltas, padded to a fixed D.
+
+    Single-sided: a real informer event reports a change on exactly ONE
+    side — the kcp (upstream/spec) stream or the physical (downstream/
+    status) stream — the reference's two controllers each watch one
+    apiserver (pkg/syncer/specsyncer.go:43-55, statussyncer.go:29-39).
+    One payload column per row, routed by ``side``, halves the
+    host->device bytes per tick vs. a both-sides layout.
+    """
 
     idx: jax.Array  # int32 [D] row indices
-    up_vals: jax.Array  # uint32 [D, S]
-    up_exists: jax.Array  # bool [D]
-    down_vals: jax.Array  # uint32 [D, S]
-    down_exists: jax.Array  # bool [D]
-    valid: jax.Array  # bool [D]
+    vals: jax.Array  # uint32 [D, S] new encoding (ignored for deletes)
+    exists: jax.Array  # bool [D] False = delete event
+    side: jax.Array  # bool [D] False = upstream mirror, True = downstream
+    valid: jax.Array  # bool [D] padding mask
 
 
 class ReconcileOutputs(NamedTuple):
+    # compact lanes — the only thing the host applier fetches each tick
+    patch_idx: jax.Array  # int32 [K] actionable row indices (pad = B)
+    patch_code: jax.Array  # uint8 [K] decision per patch row
+    patch_upsync: jax.Array  # bool [K] status-upsync flag per patch row
+    patch_count: jax.Array  # int32 [] valid patch rows
+    patch_overflow: jax.Array  # bool [] > K rows actionable this tick
+    stats: jax.Array  # int32 [8] global counters (see STATS_FIELDS)
+    # full lanes — stay device-resident; fetched only on patch_overflow
+    # or by tests/debugging
     decision: jax.Array  # uint8 [B] NOOP/CREATE/UPDATE/DELETE
     status_upsync: jax.Array  # bool [B]
     leaf_replicas: jax.Array  # int32 [R, P] desired placement
     placement_dirty: jax.Array  # bool [R]
     match_counts: jax.Array  # int32 [C] objects matched per cluster selector
-    stats: jax.Array  # int32 [8] global counters (see STATS_FIELDS)
 
 
 STATS_FIELDS = (
@@ -81,17 +96,19 @@ STATS_FIELDS = (
 )
 
 
-def reconcile_step(state: ReconcileState, deltas: ReconcileDeltas
+def reconcile_step(state: ReconcileState, deltas: ReconcileDeltas,
+                   patch_capacity: int = 8192,
                    ) -> tuple[ReconcileState, ReconcileOutputs]:
-    # 1. scatter deltas (ops/diff.apply_deltas owns the padding-drop and
-    #    dedup-by-key contract: delta batches must carry unique indices)
+    # 1. scatter deltas, routed by side (ops/diff.apply_deltas owns the
+    #    padding-drop and dedup-by-key contract: delta batches must carry
+    #    unique indices)
     up_vals, up_exists = apply_deltas(
         state.up_vals, state.up_exists, deltas.idx,
-        deltas.up_vals, deltas.up_exists, deltas.valid,
+        deltas.vals, deltas.exists, deltas.valid & ~deltas.side,
     )
     down_vals, down_exists = apply_deltas(
         state.down_vals, state.down_exists, deltas.idx,
-        deltas.down_vals, deltas.down_exists, deltas.valid,
+        deltas.vals, deltas.exists, deltas.valid & deltas.side,
     )
 
     # 2. syncer lanes
@@ -125,7 +142,11 @@ def reconcile_step(state: ReconcileState, deltas: ReconcileDeltas
         replicas=state.replicas, avail=state.avail, current=leaf,
         pair_hashes=state.pair_hashes, sel_hashes=state.sel_hashes,
     )
+    patches = compact_patches(d.decision, d.status_upsync, patch_capacity)
     outputs = ReconcileOutputs(
+        patch_idx=patches.idx, patch_code=patches.code,
+        patch_upsync=patches.upsync, patch_count=patches.count,
+        patch_overflow=patches.overflow,
         decision=d.decision, status_upsync=d.status_upsync,
         leaf_replicas=leaf, placement_dirty=p_dirty,
         match_counts=match_counts, stats=stats,
@@ -133,7 +154,98 @@ def reconcile_step(state: ReconcileState, deltas: ReconcileDeltas
     return new_state, outputs
 
 
-reconcile_step_jit = jax.jit(reconcile_step, donate_argnums=(0,))
+reconcile_step_jit = jax.jit(
+    reconcile_step, donate_argnums=(0,), static_argnames=("patch_capacity",)
+)
+
+
+# ---------------------------------------------------------------------------
+# Packed wire format — one array per direction across the host<->device link.
+#
+# When the device sits behind a network tunnel (or another host, §2.3's
+# "gRPC link ships informer deltas to a JAX worker which returns patch
+# sets"), every array is its own transfer RPC; packing the tick's deltas
+# into ONE uint32 array and the patch set + stats into ONE int32 array
+# makes a tick exactly one upload and one download regardless of lane
+# count. Patch entries carry row index (20 bits), decision code (2 bits,
+# bit 20-21) and the status-upsync flag (bit 23).
+# ---------------------------------------------------------------------------
+
+PACK_HDR = 16  # int32 slots ahead of the packed patch entries
+PACK_IDX_MASK = (1 << 20) - 1
+PACK_CODE_SHIFT = 20
+PACK_UPSYNC_BIT = 1 << 23
+
+
+def pack_deltas(deltas: ReconcileDeltas) -> np.ndarray:
+    """Host-side: pack a delta batch into one uint32 [D, S+2] array."""
+    d = np.asarray(deltas.vals).shape[0]
+    flags = (
+        np.asarray(deltas.exists).astype(np.uint32)
+        | (np.asarray(deltas.side).astype(np.uint32) << 1)
+        | (np.asarray(deltas.valid).astype(np.uint32) << 2)
+    )
+    return np.concatenate(
+        [
+            np.asarray(deltas.vals),
+            np.asarray(deltas.idx).astype(np.uint32).reshape(d, 1),
+            flags.reshape(d, 1),
+        ],
+        axis=1,
+    )
+
+
+def unpack_deltas(packed: jax.Array) -> ReconcileDeltas:
+    """Device-side (inside jit): unpack the uint32 [D, S+2] wire array."""
+    s = packed.shape[1] - 2
+    flags = packed[:, s + 1]
+    return ReconcileDeltas(
+        idx=packed[:, s].astype(jnp.int32),
+        vals=packed[:, :s],
+        exists=(flags & 1) != 0,
+        side=(flags & 2) != 0,
+        valid=(flags & 4) != 0,
+    )
+
+
+def reconcile_step_packed(state: ReconcileState, packed: jax.Array,
+                          patch_capacity: int = 8192,
+                          ) -> tuple[ReconcileState, jax.Array]:
+    """The wire-format step: one uint32 array in, one int32 array out.
+
+    Output layout: [0]=patch count, [1]=overflow flag, [2:10]=stats,
+    [PACK_HDR:]=packed patch entries (see module comment).
+    """
+    if state.up_vals.shape[0] > PACK_IDX_MASK:
+        raise ValueError(
+            f"packed patch entries hold 20-bit row indices; "
+            f"B={state.up_vals.shape[0]} exceeds {PACK_IDX_MASK} — "
+            f"shard the bucket or use the unpacked ReconcileOutputs lanes"
+        )
+    new_state, out = reconcile_step(state, unpack_deltas(packed), patch_capacity)
+    entries = (
+        out.patch_idx
+        | (out.patch_code.astype(jnp.int32) << PACK_CODE_SHIFT)
+        | jnp.where(out.patch_upsync, PACK_UPSYNC_BIT, 0)
+    )
+    hdr = jnp.zeros(PACK_HDR, jnp.int32)
+    hdr = hdr.at[0].set(out.patch_count)
+    hdr = hdr.at[1].set(out.patch_overflow.astype(jnp.int32))
+    hdr = hdr.at[2:10].set(out.stats)
+    return new_state, jnp.concatenate([hdr, entries])
+
+
+def unpack_patches(wire: np.ndarray) -> tuple[np.ndarray, np.ndarray, np.ndarray, bool, np.ndarray]:
+    """Host-side: (idx, code, upsync, overflow, stats) from the wire array."""
+    count = int(wire[0])
+    entries = wire[PACK_HDR:PACK_HDR + count]
+    return (
+        entries & PACK_IDX_MASK,
+        (entries >> PACK_CODE_SHIFT) & 3,
+        (entries & PACK_UPSYNC_BIT) != 0,
+        bool(wire[1]),
+        wire[2:10],
+    )
 
 
 def example_state(
@@ -169,10 +281,9 @@ def example_deltas(b: int = 8192, s: int = 64, d: int = 256, seed: int = 1) -> R
     # order is unspecified; the host batcher deduplicates by key)
     return ReconcileDeltas(
         idx=rng.permutation(b)[:d].astype(np.int32),
-        up_vals=rng.integers(1, 2**32, size=(d, s), dtype=np.uint32),
-        up_exists=np.ones(d, bool),
-        down_vals=rng.integers(1, 2**32, size=(d, s), dtype=np.uint32),
-        down_exists=np.ones(d, bool),
+        vals=rng.integers(1, 2**32, size=(d, s), dtype=np.uint32),
+        exists=np.ones(d, bool),
+        side=rng.random(d) < 0.5,
         valid=rng.random(d) < 0.9,
     )
 

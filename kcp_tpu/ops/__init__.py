@@ -12,13 +12,23 @@ the vectorized re-expression of the reference's per-goroutine hot loops
 - ``hashing``    host-side FNV-1a primitives feeding the encoders
 """
 
-from .diff import DECISION_CREATE, DECISION_DELETE, DECISION_NOOP, DECISION_UPDATE, sync_decisions
+from .diff import (
+    DECISION_CREATE,
+    DECISION_DELETE,
+    DECISION_NOOP,
+    DECISION_UPDATE,
+    PatchSet,
+    compact_patches,
+    sync_decisions,
+)
 from .encode import BucketEncoder, EncodedBatch
 from .placement import aggregate_status, split_replicas
 
 __all__ = [
     "BucketEncoder",
     "EncodedBatch",
+    "PatchSet",
+    "compact_patches",
     "sync_decisions",
     "split_replicas",
     "aggregate_status",

@@ -92,3 +92,46 @@ def apply_deltas(
 
 
 apply_deltas_jit = jax.jit(apply_deltas)
+
+
+class PatchSet(NamedTuple):
+    """A fixed-capacity compaction of the actionable rows of a tick.
+
+    The reference hands each actionable object to a goroutine
+    (pkg/syncer/syncer.go:293-341); our host applier instead receives this
+    bounded patch set — only rows whose decision != NOOP or that need a
+    status upsync — so the device->host link carries O(actionable), not
+    O(fleet). ``idx`` rows past ``count`` are padding (== B); ``overflow``
+    means more than ``capacity`` rows were actionable and the host should
+    fetch the full decision lane (level-triggered, so nothing is lost —
+    the next tick re-derives any row it skips).
+    """
+
+    idx: jax.Array  # int32 [K] actionable row indices, padded with B
+    code: jax.Array  # uint8 [K] decision code per patch row
+    upsync: jax.Array  # bool [K] status-upsync flag per patch row
+    count: jax.Array  # int32 [] number of valid patch rows (clamped to K)
+    overflow: jax.Array  # bool [] capacity exceeded this tick
+
+
+def compact_patches(
+    decision: jax.Array,  # uint8 [B]
+    status_upsync: jax.Array,  # bool [B]
+    capacity: int,
+) -> PatchSet:
+    """Compact the full decision lanes into a bounded patch set."""
+    b = decision.shape[0]
+    actionable = (decision != DECISION_NOOP) | status_upsync
+    total = actionable.sum(dtype=jnp.int32)
+    (idx,) = jnp.nonzero(actionable, size=capacity, fill_value=b)
+    safe = jnp.minimum(idx, b - 1)
+    valid = idx < b
+    code = jnp.where(valid, decision[safe], jnp.uint8(DECISION_NOOP))
+    upsync = jnp.where(valid, status_upsync[safe], False)
+    return PatchSet(
+        idx=idx.astype(jnp.int32),
+        code=code,
+        upsync=upsync,
+        count=jnp.minimum(total, capacity),
+        overflow=total > capacity,
+    )

@@ -163,8 +163,14 @@ class HttpServer:
                     finally:
                         for t in (monitor, producer):
                             t.cancel()
-                        await asyncio.gather(monitor, producer,
-                                             return_exceptions=True)
+                        results = await asyncio.gather(
+                            monitor, producer, return_exceptions=True)
+                        for r in results:
+                            if isinstance(r, Exception) and not isinstance(
+                                r, (asyncio.CancelledError, ConnectionError)
+                            ):
+                                log.error(
+                                    "stream producer failed", exc_info=r)
                     await resp._finish()
                     break  # streams always close the connection
                 keep = req.headers.get("connection", "keep-alive") != "close"

@@ -1,5 +1,6 @@
 """Batched sync-decision kernel vs the host deep-diff oracle."""
 
+import jax
 import numpy as np
 
 from kcp_tpu.ops.diff import (
@@ -111,3 +112,35 @@ def test_delete_via_delta():
     valid = np.array([True, False])
     _, out_exists = apply_deltas_jit(base.values, base.exists, idx, zeros, new_exists, valid)
     assert not np.asarray(out_exists)[0]
+
+
+def test_compact_patches_extracts_actionable_rows():
+    from kcp_tpu.ops.diff import compact_patches
+
+    decision = np.array([0, 1, 0, 2, 3, 0, 0, 0], np.uint8)
+    upsync = np.array([False, False, True, False, False, False, True, False])
+    p = jax.jit(compact_patches, static_argnames=("capacity",))(
+        decision, upsync, capacity=16
+    )
+    count = int(p.count)
+    assert count == 5 and not bool(p.overflow)
+    idx = np.asarray(p.idx)[:count]
+    np.testing.assert_array_equal(idx, [1, 2, 3, 4, 6])
+    np.testing.assert_array_equal(np.asarray(p.code)[:count], [1, 0, 2, 3, 0])
+    np.testing.assert_array_equal(
+        np.asarray(p.upsync)[:count], [False, True, False, False, True]
+    )
+    # padding rows are routed to B and carry NOOP
+    assert (np.asarray(p.idx)[count:] == 8).all()
+    assert (np.asarray(p.code)[count:] == DECISION_NOOP).all()
+
+
+def test_compact_patches_overflow():
+    from kcp_tpu.ops.diff import compact_patches
+
+    decision = np.full(32, 2, np.uint8)
+    p = jax.jit(compact_patches, static_argnames=("capacity",))(
+        decision, np.zeros(32, bool), capacity=4
+    )
+    assert int(p.count) == 4 and bool(p.overflow)
+    np.testing.assert_array_equal(np.asarray(p.idx), [0, 1, 2, 3])

@@ -35,8 +35,8 @@ def test_step_applies_deltas_and_counts_them():
     idx = np.arange(d, dtype=np.int32)
     vals = np.full((d, 16), 7, np.uint32)
     deltas = ReconcileDeltas(
-        idx=idx, up_vals=vals, up_exists=np.ones(d, bool),
-        down_vals=vals, down_exists=np.ones(d, bool),
+        idx=idx, vals=vals, exists=np.ones(d, bool),
+        side=np.zeros(d, bool),  # upstream stream
         valid=np.array([True] * 4 + [False] * 4),
     )
     new_state, out = jax.jit(reconcile_step)(state, deltas)
@@ -110,3 +110,50 @@ def test_graft_entry_contract():
     jax.block_until_ready(out)
     ge.dryrun_multichip(8)
     ge.dryrun_multichip(5)  # odd counts fall back to a 1D tenants mesh
+
+
+def test_packed_wire_roundtrip_matches_unpacked_step():
+    from kcp_tpu.models.reconcile_model import (
+        pack_deltas,
+        reconcile_step_packed,
+        unpack_deltas,
+        unpack_patches,
+    )
+
+    state = example_state(b=256, s=16, r=16, p=4, l=2, c=4, dirty_frac=0.2)
+    deltas = example_deltas(b=256, s=16, d=32)
+
+    packed = pack_deltas(deltas)
+    rt = jax.jit(unpack_deltas)(packed)
+    for a, b in zip(deltas, rt):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    ref_state, ref_out = jax.jit(reconcile_step)(state, deltas)
+    new_state, wire = jax.jit(
+        reconcile_step_packed, static_argnames=("patch_capacity",)
+    )(state, packed, patch_capacity=256)
+
+    idx, code, upsync, overflow, stats = unpack_patches(np.asarray(wire))
+    np.testing.assert_array_equal(stats, np.asarray(ref_out.stats))
+    np.testing.assert_array_equal(np.asarray(new_state.up_vals),
+                                  np.asarray(ref_state.up_vals))
+    decision = np.asarray(ref_out.decision)
+    want = np.flatnonzero((decision != 0) | np.asarray(ref_out.status_upsync))
+    assert not overflow
+    np.testing.assert_array_equal(idx, want)
+    np.testing.assert_array_equal(code, decision[want])
+    np.testing.assert_array_equal(upsync, np.asarray(ref_out.status_upsync)[want])
+
+
+def test_patch_lanes_in_outputs_match_full_lanes():
+    state = example_state(b=512, s=32, r=64, p=4, l=4, c=8, dirty_frac=0.1)
+    deltas = example_deltas(b=512, s=32, d=32)
+    _, out = jax.jit(reconcile_step, static_argnames=("patch_capacity",))(
+        state, deltas, patch_capacity=512
+    )
+    decision = np.asarray(out.decision)
+    upsync = np.asarray(out.status_upsync)
+    want = np.flatnonzero((decision != 0) | upsync)
+    count = int(out.patch_count)
+    assert count == want.size and not bool(out.patch_overflow)
+    np.testing.assert_array_equal(np.asarray(out.patch_idx)[:count], want)
