@@ -1,0 +1,250 @@
+"""Loader + ctypes bindings for the native runtime library.
+
+The C++ library (``native/`` at the repo root) provides the runtime
+components the reference keeps native-adjacent (its embedded etcd is a
+Go-wrapped C-lineage storage engine; pkg/etcd/etcd.go): a durable WAL
+storage engine and the object-encoding hot loop. Python is the
+orchestration layer; anything that runs per-mutation or per-object goes
+through here when the library is available.
+
+The library is built on demand with ``make`` (toolchain is expected in
+the image); if building or loading fails, ``load()`` returns ``None``
+and every caller falls back to the pure-Python path — the native layer
+is an accelerator, never a requirement. Set ``KCP_TPU_NO_NATIVE=1`` to
+force the fallback (used by differential tests).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Iterator
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
+_LIB_NAME = "libkcpnative.so"
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_load_attempted = False
+
+
+def _sources_newer_than_lib(lib_path: str) -> bool:
+    lib_mtime = os.path.getmtime(lib_path)
+    for fn in os.listdir(_NATIVE_DIR):
+        if fn.endswith((".cc", ".h")) and os.path.getmtime(os.path.join(_NATIVE_DIR, fn)) > lib_mtime:
+            return True
+    return False
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+
+    lib.ws_open.restype = ctypes.c_void_p
+    lib.ws_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.ws_close.argtypes = [ctypes.c_void_p]
+    lib.ws_last_error.restype = ctypes.c_char_p
+    lib.ws_last_error.argtypes = [ctypes.c_void_p]
+    lib.ws_put.restype = ctypes.c_int
+    lib.ws_put.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+                           ctypes.c_char_p, ctypes.c_uint32, ctypes.c_uint64]
+    lib.ws_del.restype = ctypes.c_int
+    lib.ws_del.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32, ctypes.c_uint64]
+    lib.ws_get.restype = ctypes.c_int
+    lib.ws_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+                           ctypes.POINTER(u8p), u32p]
+    lib.ws_rv.restype = ctypes.c_uint64
+    lib.ws_rv.argtypes = [ctypes.c_void_p]
+    lib.ws_count.restype = ctypes.c_uint64
+    lib.ws_count.argtypes = [ctypes.c_void_p]
+    lib.ws_flush.restype = ctypes.c_int
+    lib.ws_flush.argtypes = [ctypes.c_void_p]
+    lib.ws_snapshot.restype = ctypes.c_int
+    lib.ws_snapshot.argtypes = [ctypes.c_void_p]
+    lib.ws_scan.restype = ctypes.c_void_p
+    lib.ws_scan.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32]
+    lib.ws_scan_next.restype = ctypes.c_int
+    lib.ws_scan_next.argtypes = [ctypes.c_void_p, ctypes.POINTER(u8p), u32p,
+                                 ctypes.POINTER(u8p), u32p]
+    lib.ws_scan_free.argtypes = [ctypes.c_void_p]
+
+    lib.enc_bucket_new.restype = ctypes.c_void_p
+    lib.enc_bucket_new.argtypes = [ctypes.c_uint32]
+    lib.enc_bucket_free.argtypes = [ctypes.c_void_p]
+    lib.enc_bucket_encode.restype = ctypes.c_int
+    lib.enc_bucket_encode.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t, u32p]
+    lib.enc_bucket_nslots.restype = ctypes.c_uint32
+    lib.enc_bucket_nslots.argtypes = [ctypes.c_void_p]
+    lib.enc_bucket_path.restype = ctypes.c_int
+    lib.enc_bucket_path.argtypes = [ctypes.c_void_p, ctypes.c_uint32,
+                                    ctypes.POINTER(ctypes.c_char_p), u32p]
+    lib.enc_bucket_add_path.restype = ctypes.c_int
+    lib.enc_bucket_add_path.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32]
+    lib.enc_hash_value.restype = ctypes.c_uint32
+    lib.enc_hash_value.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+    lib.enc_fnv1a.restype = ctypes.c_uint32
+    lib.enc_fnv1a.argtypes = [ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint32]
+    lib.enc_hash_pair.restype = ctypes.c_uint32
+    lib.enc_hash_pair.argtypes = [ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+                                  ctypes.c_size_t]
+
+
+def load() -> ctypes.CDLL | None:
+    """Load (building if needed) the native library, or None."""
+    global _lib, _load_attempted
+    if os.environ.get("KCP_TPU_NO_NATIVE"):
+        return None
+    with _lock:
+        if _load_attempted:
+            return _lib
+        _load_attempted = True
+        lib_path = os.path.join(_NATIVE_DIR, _LIB_NAME)
+        try:
+            if not os.path.exists(lib_path) or _sources_newer_than_lib(lib_path):
+                subprocess.run(
+                    ["make", "-s", "-C", _NATIVE_DIR],
+                    check=True, capture_output=True, timeout=120,
+                )
+            lib = ctypes.CDLL(lib_path)
+            _declare(lib)
+            _lib = lib
+        except Exception:
+            _lib = None
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+class WalEngine:
+    """Durable WAL storage engine handle (native walstore.cc).
+
+    Keys and values are bytes; the store layers its
+    ``/<resource>/<cluster>/<ns>/<name>`` scheme on top with NUL-joined
+    key tuples so prefix scans follow the etcd range-scan idiom
+    (docs/investigations/logical-clusters.md:70-74 in the reference).
+    """
+
+    def __init__(self, path: str, sync_every: int = 256):
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._h = lib.ws_open(path.encode(), sync_every)
+        if not self._h:
+            raise OSError(f"ws_open({path!r}) failed")
+
+    def put(self, key: bytes, val: bytes, rv: int) -> None:
+        if self._lib.ws_put(self._h, key, len(key), val, len(val), rv) != 0:
+            raise OSError(self._lib.ws_last_error(self._h).decode())
+
+    def delete(self, key: bytes, rv: int) -> None:
+        if self._lib.ws_del(self._h, key, len(key), rv) != 0:
+            raise OSError(self._lib.ws_last_error(self._h).decode())
+
+    def get(self, key: bytes) -> bytes | None:
+        val = ctypes.POINTER(ctypes.c_uint8)()
+        vlen = ctypes.c_uint32()
+        if self._lib.ws_get(self._h, key, len(key), ctypes.byref(val), ctypes.byref(vlen)):
+            return ctypes.string_at(val, vlen.value)
+        return None
+
+    @property
+    def rv(self) -> int:
+        return self._lib.ws_rv(self._h)
+
+    def __len__(self) -> int:
+        return self._lib.ws_count(self._h)
+
+    def flush(self) -> None:
+        if self._lib.ws_flush(self._h) != 0:
+            raise OSError("fsync failed")
+
+    def snapshot(self) -> None:
+        if self._lib.ws_snapshot(self._h) != 0:
+            raise OSError("snapshot failed")
+
+    def scan(self, prefix: bytes = b"") -> Iterator[tuple[bytes, bytes]]:
+        cur = self._lib.ws_scan(self._h, prefix, len(prefix))
+        try:
+            key = ctypes.POINTER(ctypes.c_uint8)()
+            val = ctypes.POINTER(ctypes.c_uint8)()
+            klen = ctypes.c_uint32()
+            vlen = ctypes.c_uint32()
+            while self._lib.ws_scan_next(cur, ctypes.byref(key), ctypes.byref(klen),
+                                         ctypes.byref(val), ctypes.byref(vlen)):
+                yield ctypes.string_at(key, klen.value), ctypes.string_at(val, vlen.value)
+        finally:
+            self._lib.ws_scan_free(cur)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.ws_close(self._h)
+            self._h = None
+
+
+class NativeBucket:
+    """Native slot-vocabulary encoder (twin of ops.encode.BucketEncoder)."""
+
+    OVERFLOW = -1
+
+    def __init__(self, capacity: int):
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self.capacity = capacity
+        self._h = lib.enc_bucket_new(capacity)
+
+    def encode_json(self, json_bytes: bytes, out) -> int:
+        """Encode one object's JSON into out (uint32[capacity] numpy).
+
+        Returns 0 ok, -1 overflow, -2/-3 parse errors.
+        """
+        import numpy as np
+
+        direct = out.flags["C_CONTIGUOUS"] and out.dtype == np.uint32
+        buf = out if direct else np.zeros(self.capacity, dtype=np.uint32)
+        ptr = buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32))
+        rc = self._lib.enc_bucket_encode(self._h, json_bytes, len(json_bytes), ptr)
+        if not direct and rc == 0:
+            out[:] = buf
+        return rc
+
+    @property
+    def nslots(self) -> int:
+        return self._lib.enc_bucket_nslots(self._h)
+
+    def slot_paths(self) -> list[str]:
+        out = []
+        path = ctypes.c_char_p()
+        plen = ctypes.c_uint32()
+        for slot in range(self.nslots):
+            if self._lib.enc_bucket_path(self._h, slot, ctypes.byref(path), ctypes.byref(plen)):
+                out.append(path.value[:plen.value].decode())
+        return out
+
+    def add_path(self, path: str) -> int:
+        return self._lib.enc_bucket_add_path(self._h, path.encode(), len(path.encode()))
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.enc_bucket_free(self._h)
+        except Exception:
+            pass
+
+
+def hash_value_native(json_bytes: bytes) -> int:
+    lib = load()
+    assert lib is not None
+    return lib.enc_hash_value(json_bytes, len(json_bytes))
+
+
+def fnv1a_native(data: bytes, seed: int = 0x811C9DC5) -> int:
+    lib = load()
+    assert lib is not None
+    return lib.enc_fnv1a(data, len(data), seed)

@@ -88,11 +88,20 @@ class EncodedBatch:
 
 @dataclass
 class BucketEncoder:
-    """Slot vocabulary + encoder for one schema bucket."""
+    """Slot vocabulary + encoder for one schema bucket.
+
+    When the native library (native/encode.cc) loads, encoding runs
+    through the C++ flatten+hash pipeline — byte-for-byte identical to
+    the Python path (differential-tested in tests/test_native.py) — and
+    the vocabulary is mirrored back after each call so
+    :meth:`status_mask` and callers keep working unchanged.
+    """
 
     capacity: int = 64
     slots: dict[str, int] = field(default_factory=dict)
     slot_paths: list[str] = field(default_factory=list)
+    _native: Any = field(default=None, repr=False, compare=False)
+    _native_tried: bool = field(default=False, repr=False, compare=False)
 
     def _slot_for(self, path: str) -> int:
         slot = self.slots.get(path)
@@ -106,10 +115,52 @@ class BucketEncoder:
             self.slot_paths.append(path)
         return slot
 
+    def _native_bucket(self):
+        if not self._native_tried:
+            self._native_tried = True
+            try:
+                from ..native import NativeBucket, available
+
+                if available():
+                    nb = NativeBucket(self.capacity)
+                    for path in self.slot_paths:  # seed existing vocab
+                        nb.add_path(path)
+                    self._native = nb
+            except Exception:
+                self._native = None
+        return self._native
+
+    def _sync_native_vocab(self, nb) -> None:
+        if nb.nslots > len(self.slot_paths):
+            for path in nb.slot_paths()[len(self.slot_paths):]:
+                self.slots[path] = len(self.slot_paths)
+                self.slot_paths.append(path)
+
     def encode(self, obj: Mapping, out: np.ndarray | None = None) -> np.ndarray:
         """Encode one object into a uint32[capacity] vector."""
         if out is None:
             out = np.zeros(self.capacity, dtype=np.uint32)
+        nb = self._native_bucket()
+        if nb is not None:
+            import json
+
+            try:
+                payload = json.dumps(obj).encode("utf-8")
+            except (TypeError, ValueError):
+                payload = None
+            rc = nb.encode_json(payload, out) if payload is not None else -2
+            if rc == 0:
+                self._sync_native_vocab(nb)
+                return out
+            if rc == -1:
+                self._sync_native_vocab(nb)
+                raise BucketOverflow(f"bucket full at {self.capacity} slots")
+            # Parse anomaly (e.g. >128-deep nesting, non-serializable
+            # value): retire the native bucket for good — continuing to
+            # use it after the Python path grows the vocabulary would
+            # break the prefix invariant _sync_native_vocab relies on and
+            # silently scramble slot assignments.
+            self._native = None
         for path, value in flatten_object(obj):
             out[self._slot_for(path)] = hash_value(value)
         return out

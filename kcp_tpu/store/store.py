@@ -207,20 +207,95 @@ class _WalConfig:
     snapshot_every: int = 50_000
 
 
+def _wal_key(key: Key) -> bytes:
+    """NUL-joined key tuple: ordered by (resource, cluster, ns, name) so
+    native prefix scans follow the etcd range-scan idiom."""
+    return "\x00".join(key).encode("utf-8")
+
+
+def _detect_wal_format(path: str) -> str | None:
+    """Sniff an existing WAL's format: "json" (JSON-lines), "native"
+    (binary records), or None (absent/empty — either works).
+
+    JSON-lines records always start with ``{"op":``; binary records start
+    with a little-endian u32 length whose first byte is never ``{`` for
+    any record under ~2GB with sane sizes (0x7B as the low length byte is
+    possible, so the JSON probe is authoritative, not the binary one).
+    """
+    for candidate in (path, path + ".snap"):
+        try:
+            with open(candidate, "rb") as f:
+                head = f.read(16)
+        except OSError:
+            continue
+        if not head:
+            continue
+        return "json" if head.lstrip()[:1] == b"{" else "native"
+    return None
+
+
 class LogicalStore:
     """The multi-tenant object store + watch hub."""
 
-    def __init__(self, wal_path: str | None = None, clock: Callable[[], float] = time.time):
+    def __init__(
+        self,
+        wal_path: str | None = None,
+        clock: Callable[[], float] = time.time,
+        wal_backend: str = "auto",
+        wal_sync_every: int = 256,
+    ):
+        """``wal_backend``: "auto" uses the native C++ engine
+        (native/walstore.cc — binary records, CRC32 torn-write recovery,
+        batched fsync) when the library loads, else the JSON-lines
+        fallback; "native"/"json" force a choice.
+        """
         self._objects: dict[Key, dict] = {}
         self._rv = 0
         self._watches: list[Watch] = []
         self._history: deque[Event] = deque(maxlen=200_000)
         self._clock = clock
         self._wal: _WalConfig | None = None
+        self._engine = None
+        self._engine_mutations = 0
+        self._engine_snapshot_every = 50_000
         if wal_path:
-            self._wal = _WalConfig(path=wal_path)
-            self._load_wal()
-            self._wal.fh = open(wal_path, "a", encoding="utf-8")
+            existing = _detect_wal_format(wal_path)
+            if wal_backend == "auto":
+                # never reinterpret an existing WAL under a different
+                # format — the native engine would truncate a JSON WAL as
+                # a torn tail and destroy it
+                use_native = existing != "json"
+            elif wal_backend == "native":
+                if existing == "json":
+                    raise InvalidError(
+                        f"{wal_path} holds a JSON-lines WAL; migrate it (load with "
+                        f"wal_backend='json', snapshot to a fresh path) before "
+                        f"forcing the native engine"
+                    )
+                use_native = True
+            else:
+                if existing == "native":
+                    raise InvalidError(
+                        f"{wal_path} holds a native binary WAL; it cannot be "
+                        f"opened with wal_backend='json'"
+                    )
+                use_native = False
+            if use_native:
+                try:
+                    from ..native import WalEngine
+
+                    self._engine = WalEngine(wal_path, sync_every=wal_sync_every)
+                except Exception:
+                    if wal_backend == "native":
+                        raise
+                    if existing == "native":
+                        raise  # a binary WAL is unreadable without the engine
+            if self._engine is not None:
+                self._load_engine()
+            else:
+                self._wal = _WalConfig(path=wal_path)
+                self._load_wal()
+                self._wal.fh = open(wal_path, "a", encoding="utf-8")
 
     # ------------------------------------------------------------------ RV
 
@@ -464,6 +539,21 @@ class LogicalStore:
     # ---------------------------------------------------------- durability
 
     def _log_wal(self, rec: dict) -> None:
+        if self._engine is not None:
+            key = _wal_key(tuple(rec["key"]))
+            if rec["op"] == "put":
+                self._engine.put(
+                    key,
+                    json.dumps(rec["obj"], separators=(",", ":")).encode("utf-8"),
+                    rec["rv"],
+                )
+            else:
+                self._engine.delete(key, rec["rv"])
+            self._engine_mutations += 1
+            if self._engine_mutations >= self._engine_snapshot_every:
+                self._engine.snapshot()
+                self._engine_mutations = 0
+            return
         if self._wal is None or self._wal.fh is None:
             return
         self._wal.fh.write(json.dumps(rec, separators=(",", ":")) + "\n")
@@ -471,6 +561,13 @@ class LogicalStore:
         self._wal.mutations_since_snapshot += 1
         if self._wal.mutations_since_snapshot >= self._wal.snapshot_every:
             self.snapshot()
+
+    def _load_engine(self) -> None:
+        assert self._engine is not None
+        for key, val in self._engine.scan():
+            parts = tuple(key.decode("utf-8").split("\x00"))
+            self._objects[parts] = json.loads(val)
+        self._rv = self._engine.rv
 
     def _load_wal(self) -> None:
         assert self._wal is not None
@@ -497,6 +594,9 @@ class LogicalStore:
 
     def snapshot(self) -> None:
         """Write a snapshot and truncate the WAL (etcd compaction analog)."""
+        if self._engine is not None:
+            self._engine.snapshot()
+            return
         if self._wal is None:
             return
         snap = self._wal.path + ".snap"
@@ -520,6 +620,9 @@ class LogicalStore:
     def close(self) -> None:
         for w in list(self._watches):
             w.close()
+        if self._engine is not None:
+            self._engine.close()
+            self._engine = None
         if self._wal is not None and self._wal.fh is not None:
             self._wal.fh.close()
             self._wal.fh = None

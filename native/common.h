@@ -1,0 +1,44 @@
+// Shared primitives for the kcp-tpu native runtime library.
+//
+// The hash functions here are byte-for-byte twins of
+// kcp_tpu/ops/hashing.py (FNV-1a over canonical JSON); the CRC32 guards
+// WAL records against torn writes. Host Python, device kernels and this
+// library must agree on every hash, so change nothing here without
+// changing the Python side in lockstep.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+
+namespace kcpnative {
+
+constexpr uint32_t FNV_OFFSET = 0x811C9DC5u;
+constexpr uint32_t FNV_PRIME = 0x01000193u;
+
+inline uint32_t fnv1a(const uint8_t* data, size_t len, uint32_t seed = FNV_OFFSET) {
+  uint32_t h = seed;
+  for (size_t i = 0; i < len; i++) {
+    h ^= data[i];
+    h *= FNV_PRIME;
+  }
+  return h;
+}
+
+// CRC-32 (IEEE 802.3, reflected), table generated on first use.
+inline uint32_t crc32(const uint8_t* data, size_t len) {
+  static uint32_t table[256];
+  static bool init = false;
+  if (!init) {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      table[i] = c;
+    }
+    init = true;
+  }
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < len; i++) c = table[(c ^ data[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+}  // namespace kcpnative

@@ -1,0 +1,166 @@
+// Native object encoder — the host hot loop feeding the device diff
+// kernels. Byte-for-byte twin of kcp_tpu/ops/encode.py (flatten_object
+// + BucketEncoder + hash_value): parses an object's JSON (as produced
+// by Python's json.dumps), flattens it to dotted-path leaves (sorted
+// keys, volatile metadata dropped, subtrees deeper than max_depth=8
+// hashed whole), assigns slots first-seen, and writes FNV-1a hashes of
+// each leaf's canonical JSON into the output vector.
+//
+// Reference behavior being vectorized: pkg/syncer/specsyncer.go:17-41
+// deepEqualApartFromStatus runs a full deep-equal per informer event;
+// here the equal collapses to uint32 lane compares on device, and this
+// encoder is what gets objects into lane form.
+#include "kcpnative.h"
+
+#include <algorithm>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+#include "jsoncanon.h"
+
+namespace {
+
+using kcpnative::fnv1a;
+using kcpnative::JValue;
+
+constexpr int MAX_DEPTH = 8;
+
+const char* const VOLATILE_META[] = {"resourceVersion", "generation", "uid",
+                                     "creationTimestamp", "managedFields"};
+
+bool is_volatile_meta(const std::string& k) {
+  for (const char* m : VOLATILE_META)
+    if (k == m) return true;
+  return false;
+}
+
+struct Bucket {
+  uint32_t capacity;
+  std::unordered_map<std::string, uint32_t> slots;
+  std::vector<std::string> paths;
+
+  int slot_for(const std::string& path) {
+    auto it = slots.find(path);
+    if (it != slots.end()) return int(it->second);
+    if (paths.size() >= capacity) return -1;
+    uint32_t slot = uint32_t(paths.size());
+    slots.emplace(path, slot);
+    paths.push_back(path);
+    return int(slot);
+  }
+};
+
+uint32_t hash_jvalue(const JValue& v) {
+  std::string canon;
+  kcpnative::json_canon(v, &canon);
+  uint32_t h = fnv1a(reinterpret_cast<const uint8_t*>(canon.data()), canon.size());
+  return h ? h : 1;  // 0 is the "absent" sentinel in encoded tensors
+}
+
+// Sorted key order over an object's entries (duplicates keep last, as
+// json.loads does).
+std::vector<const std::pair<std::string, JValue>*> sorted_entries(const JValue& obj) {
+  std::vector<const std::pair<std::string, JValue>*> entries;
+  entries.reserve(obj.obj.size());
+  for (const auto& e : obj.obj) entries.push_back(&e);
+  std::stable_sort(entries.begin(), entries.end(),
+                   [](const auto* a, const auto* b) { return a->first < b->first; });
+  std::vector<const std::pair<std::string, JValue>*> out;
+  out.reserve(entries.size());
+  for (size_t i = 0; i < entries.size(); i++) {
+    if (i + 1 < entries.size() && entries[i]->first == entries[i + 1]->first) continue;
+    out.push_back(entries[i]);
+  }
+  return out;
+}
+
+// Returns false on slot overflow.
+bool walk(Bucket* b, const std::string& prefix, const JValue& v, int depth, uint32_t* out) {
+  if (v.type == JValue::Obj && depth < MAX_DEPTH) {
+    if (v.obj.empty()) {
+      int slot = b->slot_for(prefix);
+      if (slot < 0) return false;
+      out[slot] = hash_jvalue(v);  // hash of "{}"
+      return true;
+    }
+    for (const auto* e : sorted_entries(v)) {
+      if (depth == 1 && prefix == "metadata" && is_volatile_meta(e->first)) continue;
+      if (!walk(b, prefix + "." + e->first, e->second, depth + 1, out)) return false;
+    }
+    return true;
+  }
+  int slot = b->slot_for(prefix);
+  if (slot < 0) return false;
+  out[slot] = hash_jvalue(v);
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* enc_bucket_new(uint32_t capacity) {
+  auto* b = new Bucket();
+  b->capacity = capacity;
+  return b;
+}
+
+void enc_bucket_free(void* b) { delete static_cast<Bucket*>(b); }
+
+int enc_bucket_encode(void* bp, const char* json, size_t len, uint32_t* out) {
+  auto* b = static_cast<Bucket*>(bp);
+  JValue root;
+  std::string err;
+  if (!kcpnative::json_parse(json, len, &root, &err)) return -2;
+  if (root.type != JValue::Obj) return -3;
+  for (uint32_t i = 0; i < b->capacity; i++) out[i] = 0;
+  for (const auto* e : sorted_entries(root)) {
+    if (e->first == "apiVersion" || e->first == "kind") {
+      int slot = b->slot_for(e->first);
+      if (slot < 0) return -1;
+      out[slot] = hash_jvalue(e->second);
+      continue;
+    }
+    if (!walk(b, e->first, e->second, 1, out)) return -1;
+  }
+  return 0;
+}
+
+uint32_t enc_bucket_nslots(void* b) { return uint32_t(static_cast<Bucket*>(b)->paths.size()); }
+
+int enc_bucket_path(void* bp, uint32_t slot, const char** path, uint32_t* plen) {
+  auto* b = static_cast<Bucket*>(bp);
+  if (slot >= b->paths.size()) return 0;
+  *path = b->paths[slot].c_str();
+  *plen = uint32_t(b->paths[slot].size());
+  return 1;
+}
+
+int enc_bucket_add_path(void* bp, const char* path, uint32_t plen) {
+  return static_cast<Bucket*>(bp)->slot_for(std::string(path, plen));
+}
+
+uint32_t enc_hash_value(const char* json, size_t len) {
+  JValue v;
+  std::string err;
+  if (!kcpnative::json_parse(json, len, &v, &err)) return 0;
+  return hash_jvalue(v);
+}
+
+uint32_t enc_fnv1a(const uint8_t* data, size_t len, uint32_t seed) {
+  return fnv1a(data, len, seed);
+}
+
+uint32_t enc_hash_pair(const uint8_t* key, size_t klen, const uint8_t* value, size_t vlen) {
+  std::string buf;
+  buf.reserve(klen + 1 + vlen);
+  buf.append(reinterpret_cast<const char*>(key), klen);
+  buf.push_back('\0');
+  buf.append(reinterpret_cast<const char*>(value), vlen);
+  uint32_t h = fnv1a(reinterpret_cast<const uint8_t*>(buf.data()), buf.size());
+  return h ? h : 1;
+}
+
+}  // extern "C"

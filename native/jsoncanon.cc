@@ -1,0 +1,308 @@
+#include "jsoncanon.h"
+
+#include <algorithm>
+#include <cstdio>
+#include <cstring>
+
+namespace kcpnative {
+
+namespace {
+
+struct Parser {
+  const char* p;
+  const char* end;
+  std::string* err;
+
+  bool fail(const char* msg) {
+    if (err) *err = msg;
+    return false;
+  }
+
+  void skip_ws() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r')) p++;
+  }
+
+  bool literal(const char* lit) {
+    size_t n = strlen(lit);
+    if (size_t(end - p) < n || memcmp(p, lit, n) != 0) return false;
+    p += n;
+    return true;
+  }
+
+  // Decode a \uXXXX escape (possibly a surrogate pair) into UTF-8.
+  bool unicode_escape(std::string* out) {
+    auto hex4 = [&](uint32_t* v) -> bool {
+      if (end - p < 4) return false;
+      uint32_t r = 0;
+      for (int i = 0; i < 4; i++) {
+        char c = p[i];
+        r <<= 4;
+        if (c >= '0' && c <= '9') r |= uint32_t(c - '0');
+        else if (c >= 'a' && c <= 'f') r |= uint32_t(c - 'a' + 10);
+        else if (c >= 'A' && c <= 'F') r |= uint32_t(c - 'A' + 10);
+        else return false;
+      }
+      p += 4;
+      *v = r;
+      return true;
+    };
+    uint32_t cp;
+    if (!hex4(&cp)) return fail("bad \\u escape");
+    if (cp >= 0xD800 && cp <= 0xDBFF && end - p >= 6 && p[0] == '\\' && p[1] == 'u') {
+      p += 2;
+      uint32_t lo;
+      if (!hex4(&lo)) return fail("bad surrogate pair");
+      if (lo >= 0xDC00 && lo <= 0xDFFF) {
+        cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+      } else {
+        // unpaired high surrogate followed by a non-low \u escape:
+        // emit replacement-style passthrough of both (Python would have
+        // errored producing this; keep it lossy but total)
+        out->append("\xEF\xBF\xBD");
+        cp = lo;
+      }
+    }
+    if (cp < 0x80) {
+      out->push_back(char(cp));
+    } else if (cp < 0x800) {
+      out->push_back(char(0xC0 | (cp >> 6)));
+      out->push_back(char(0x80 | (cp & 0x3F)));
+    } else if (cp < 0x10000) {
+      out->push_back(char(0xE0 | (cp >> 12)));
+      out->push_back(char(0x80 | ((cp >> 6) & 0x3F)));
+      out->push_back(char(0x80 | (cp & 0x3F)));
+    } else {
+      out->push_back(char(0xF0 | (cp >> 18)));
+      out->push_back(char(0x80 | ((cp >> 12) & 0x3F)));
+      out->push_back(char(0x80 | ((cp >> 6) & 0x3F)));
+      out->push_back(char(0x80 | (cp & 0x3F)));
+    }
+    return true;
+  }
+
+  bool parse_string(std::string* out) {
+    if (p >= end || *p != '"') return fail("expected string");
+    p++;
+    while (p < end) {
+      char c = *p;
+      if (c == '"') {
+        p++;
+        return true;
+      }
+      if (c == '\\') {
+        p++;
+        if (p >= end) return fail("truncated escape");
+        char e = *p++;
+        switch (e) {
+          case '"': out->push_back('"'); break;
+          case '\\': out->push_back('\\'); break;
+          case '/': out->push_back('/'); break;
+          case 'b': out->push_back('\b'); break;
+          case 'f': out->push_back('\f'); break;
+          case 'n': out->push_back('\n'); break;
+          case 'r': out->push_back('\r'); break;
+          case 't': out->push_back('\t'); break;
+          case 'u':
+            if (!unicode_escape(out)) return false;
+            break;
+          default: return fail("bad escape");
+        }
+      } else {
+        out->push_back(c);
+        p++;
+      }
+    }
+    return fail("unterminated string");
+  }
+
+  bool parse_number(JValue* v) {
+    const char* start = p;
+    if (p < end && *p == '-') p++;
+    while (p < end && ((*p >= '0' && *p <= '9') || *p == '.' || *p == 'e' || *p == 'E' ||
+                       *p == '+' || *p == '-'))
+      p++;
+    if (p == start) return fail("bad number");
+    v->type = JValue::Num;
+    v->num.assign(start, size_t(p - start));
+    return true;
+  }
+
+  bool parse_value(JValue* v, int depth) {
+    if (depth > 128) return fail("nesting too deep");
+    skip_ws();
+    if (p >= end) return fail("unexpected end");
+    char c = *p;
+    if (c == '{') {
+      p++;
+      v->type = JValue::Obj;
+      skip_ws();
+      if (p < end && *p == '}') {
+        p++;
+        return true;
+      }
+      while (true) {
+        skip_ws();
+        std::string key;
+        if (!parse_string(&key)) return false;
+        skip_ws();
+        if (p >= end || *p != ':') return fail("expected ':'");
+        p++;
+        JValue child;
+        if (!parse_value(&child, depth + 1)) return false;
+        v->obj.emplace_back(std::move(key), std::move(child));
+        skip_ws();
+        if (p < end && *p == ',') {
+          p++;
+          continue;
+        }
+        if (p < end && *p == '}') {
+          p++;
+          return true;
+        }
+        return fail("expected ',' or '}'");
+      }
+    }
+    if (c == '[') {
+      p++;
+      v->type = JValue::Arr;
+      skip_ws();
+      if (p < end && *p == ']') {
+        p++;
+        return true;
+      }
+      while (true) {
+        JValue child;
+        if (!parse_value(&child, depth + 1)) return false;
+        v->arr.push_back(std::move(child));
+        skip_ws();
+        if (p < end && *p == ',') {
+          p++;
+          continue;
+        }
+        if (p < end && *p == ']') {
+          p++;
+          return true;
+        }
+        return fail("expected ',' or ']'");
+      }
+    }
+    if (c == '"') {
+      v->type = JValue::Str;
+      return parse_string(&v->str);
+    }
+    if (literal("true")) {
+      v->type = JValue::Bool;
+      v->b = true;
+      return true;
+    }
+    if (literal("false")) {
+      v->type = JValue::Bool;
+      v->b = false;
+      return true;
+    }
+    if (literal("null")) {
+      v->type = JValue::Null;
+      return true;
+    }
+    // Python's json emits these non-standard tokens for float
+    // nan/inf — keep them as verbatim number tokens.
+    if (literal("NaN")) {
+      v->type = JValue::Num;
+      v->num = "NaN";
+      return true;
+    }
+    if (literal("Infinity")) {
+      v->type = JValue::Num;
+      v->num = "Infinity";
+      return true;
+    }
+    if (c == '-' && size_t(end - p) >= 9 && memcmp(p, "-Infinity", 9) == 0) {
+      p += 9;
+      v->type = JValue::Num;
+      v->num = "-Infinity";
+      return true;
+    }
+    return parse_number(v);
+  }
+};
+
+// Python json.dumps(ensure_ascii=False) escaping: ", \, short escapes
+// for \b \t \n \f \r, \u00xx for remaining control chars, everything
+// else raw.
+void write_escaped(const std::string& s, std::string* out) {
+  out->push_back('"');
+  for (unsigned char c : s) {
+    switch (c) {
+      case '"': out->append("\\\""); break;
+      case '\\': out->append("\\\\"); break;
+      case '\b': out->append("\\b"); break;
+      case '\f': out->append("\\f"); break;
+      case '\n': out->append("\\n"); break;
+      case '\r': out->append("\\r"); break;
+      case '\t': out->append("\\t"); break;
+      default:
+        if (c < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof(buf), "\\u%04x", c);
+          out->append(buf);
+        } else {
+          out->push_back(char(c));
+        }
+    }
+  }
+  out->push_back('"');
+}
+
+}  // namespace
+
+bool json_parse(const char* data, size_t len, JValue* out, std::string* err) {
+  Parser parser{data, data + len, err};
+  if (!parser.parse_value(out, 0)) return false;
+  parser.skip_ws();
+  if (parser.p != parser.end) {
+    if (err) *err = "trailing data";
+    return false;
+  }
+  return true;
+}
+
+void json_canon(const JValue& v, std::string* out) {
+  switch (v.type) {
+    case JValue::Null: out->append("null"); break;
+    case JValue::Bool: out->append(v.b ? "true" : "false"); break;
+    case JValue::Num: out->append(v.num); break;
+    case JValue::Str: write_escaped(v.str, out); break;
+    case JValue::Arr: {
+      out->push_back('[');
+      for (size_t i = 0; i < v.arr.size(); i++) {
+        if (i) out->push_back(',');
+        json_canon(v.arr[i], out);
+      }
+      out->push_back(']');
+      break;
+    }
+    case JValue::Obj: {
+      // sort by key bytes (== Python's code-point sort for UTF-8);
+      // duplicate keys keep the last occurrence, like json.loads
+      std::vector<const std::pair<std::string, JValue>*> entries;
+      entries.reserve(v.obj.size());
+      for (const auto& e : v.obj) entries.push_back(&e);
+      std::stable_sort(entries.begin(), entries.end(),
+                       [](const auto* a, const auto* b) { return a->first < b->first; });
+      out->push_back('{');
+      bool first = true;
+      for (size_t i = 0; i < entries.size(); i++) {
+        if (i + 1 < entries.size() && entries[i]->first == entries[i + 1]->first) continue;
+        if (!first) out->push_back(',');
+        first = false;
+        write_escaped(entries[i]->first, out);
+        out->push_back(':');
+        json_canon(entries[i]->second, out);
+      }
+      out->push_back('}');
+      break;
+    }
+  }
+}
+
+}  // namespace kcpnative

@@ -1,0 +1,33 @@
+// Minimal JSON DOM + canonical serializer, matching Python's
+// json.dumps(value, sort_keys=True, separators=(",", ":"),
+// ensure_ascii=False) byte for byte for any document Python's json
+// module itself produced (number tokens pass through verbatim, which is
+// what makes the parity exact — see kcp_tpu/ops/hashing.py
+// canonical_json()).
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace kcpnative {
+
+struct JValue {
+  enum Type : uint8_t { Null, Bool, Num, Str, Arr, Obj } type = Null;
+  bool b = false;
+  std::string num;  // original token text, passed through verbatim
+  std::string str;  // decoded UTF-8
+  std::vector<JValue> arr;
+  std::vector<std::pair<std::string, JValue>> obj;  // decoded keys, source order
+};
+
+// Parse one JSON document. Returns false (and sets *err) on malformed
+// input. Accepts Python's non-standard NaN/Infinity/-Infinity tokens.
+bool json_parse(const char* data, size_t len, JValue* out, std::string* err);
+
+// Append the canonical serialization (sorted keys, compact separators,
+// ensure_ascii=False escaping) to *out.
+void json_canon(const JValue& v, std::string* out);
+
+}  // namespace kcpnative

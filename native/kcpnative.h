@@ -1,0 +1,84 @@
+// C ABI of the kcp-tpu native runtime library (loaded via ctypes from
+// kcp_tpu/native/__init__.py).
+//
+// Two subsystems:
+//   ws_*  — durable WAL storage engine (the embedded-etcd analog;
+//           reference: pkg/etcd/etcd.go runs a real etcd, our store
+//           journals through this engine instead)
+//   enc_* — native object encoder (JSON -> canonical flatten -> FNV
+//           slot hashes; the host hot loop feeding the device diff
+//           kernels, twin of kcp_tpu/ops/encode.py BucketEncoder)
+//
+// All functions are thread-compatible (callers serialize access per
+// handle); no global state beyond lazily-initialized lookup tables.
+#pragma once
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+// ---------------------------------------------------------------- WAL store
+
+// Open (creating if absent) a WAL store. Replays <path>.snap then
+// <path>; torn trailing records are truncated away. sync_every batches
+// fsync: 1 = fsync every record (etcd-like durability), N = every N
+// records (group commit), 0 = never (tests). Returns NULL on error.
+void* ws_open(const char* path, int sync_every);
+void ws_close(void* h);
+const char* ws_last_error(void* h);  // valid until next call on h
+
+// Upsert / delete. rv is the store's resourceVersion for the mutation;
+// the engine tracks max(rv). Returns 0 on success, -1 on I/O error.
+int ws_put(void* h, const uint8_t* key, uint32_t klen, const uint8_t* val, uint32_t vlen,
+           uint64_t rv);
+int ws_del(void* h, const uint8_t* key, uint32_t klen, uint64_t rv);
+
+// Point lookup. Returns 1 if found (ptrs valid until next mutation),
+// 0 if absent.
+int ws_get(void* h, const uint8_t* key, uint32_t klen, const uint8_t** val, uint32_t* vlen);
+
+uint64_t ws_rv(void* h);
+uint64_t ws_count(void* h);
+int ws_flush(void* h);     // fsync now
+int ws_snapshot(void* h);  // write snapshot, truncate WAL (compaction)
+
+// Ordered prefix scan (etcd range-scan analog over the
+// /<resource>/<cluster>/<ns>/<name> keyspace). Cursor is invalidated
+// by mutations; scan fully before mutating.
+void* ws_scan(void* h, const uint8_t* prefix, uint32_t plen);
+int ws_scan_next(void* cur, const uint8_t** key, uint32_t* klen, const uint8_t** val,
+                 uint32_t* vlen);  // 1 = yielded, 0 = done
+void ws_scan_free(void* cur);
+
+// ------------------------------------------------------------ object encoder
+
+// A schema-bucket encoder: path -> slot vocabulary plus the flatten +
+// hash pipeline. enc_bucket_encode parses a JSON object (as produced by
+// Python's json.dumps) and fills out[0..capacity) with value hashes by
+// slot (0 = absent).
+void* enc_bucket_new(uint32_t capacity);
+void enc_bucket_free(void* b);
+// Returns 0 ok; -1 slot overflow (re-bucket larger); -2 parse error;
+// -3 not a JSON object.
+int enc_bucket_encode(void* b, const char* json, size_t len, uint32_t* out);
+uint32_t enc_bucket_nslots(void* b);
+// Slot path readback (for vocab mirroring into Python). Returns 1 if
+// slot exists.
+int enc_bucket_path(void* b, uint32_t slot, const char** path, uint32_t* plen);
+// Seed the vocabulary (e.g. restoring a bucket). Returns slot or -1.
+int enc_bucket_add_path(void* b, const char* path, uint32_t plen);
+
+// Hash one JSON value canonically (twin of hashing.hash_value).
+// Returns 0 only on parse error (real hashes are never 0).
+uint32_t enc_hash_value(const char* json, size_t len);
+// FNV-1a (twin of hashing.fnv1a).
+uint32_t enc_fnv1a(const uint8_t* data, size_t len, uint32_t seed);
+// Label pair hash: fnv1a(key + "\0" + value), 0 mapped to 1.
+uint32_t enc_hash_pair(const uint8_t* key, size_t klen, const uint8_t* value, size_t vlen);
+
+#ifdef __cplusplus
+}
+#endif

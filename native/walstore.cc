@@ -1,0 +1,284 @@
+// Durable WAL storage engine — the embedded-etcd analog of this
+// framework (reference: pkg/etcd/etcd.go embeds a real etcd server;
+// kcp_tpu.store.LogicalStore journals through this engine instead and
+// keeps watch/event semantics host-side in Python).
+//
+// On-disk format (little-endian), one record per mutation:
+//   [u32 payload_len][u32 crc32(payload)][payload]
+//   payload = u8 op | u64 rv | u32 klen | u32 vlen | key | val
+//   op: 1 = put, 2 = del, 3 = meta (rv watermark, empty key/val)
+// Replay stops at the first short/corrupt record and truncates the file
+// there (torn-write recovery). Snapshot compaction writes the full
+// ordered map into <path>.snap (atomic rename) and truncates the WAL.
+#include "kcpnative.h"
+
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstring>
+#include <map>
+#include <string>
+#include <vector>
+
+#include "common.h"
+
+namespace {
+
+using kcpnative::crc32;
+
+constexpr uint8_t OP_PUT = 1;
+constexpr uint8_t OP_DEL = 2;
+constexpr uint8_t OP_META = 3;
+
+struct WalStore {
+  std::string path;
+  int fd = -1;
+  int sync_every = 256;
+  int unsynced = 0;
+  uint64_t rv = 0;
+  std::map<std::string, std::string> index;  // ordered: prefix scans
+  std::string last_error;
+
+  bool fail(const std::string& msg) {
+    last_error = msg + (errno ? std::string(": ") + strerror(errno) : std::string());
+    return false;
+  }
+};
+
+struct Scan {
+  WalStore* store;
+  std::map<std::string, std::string>::const_iterator it;
+  std::string prefix;
+};
+
+void put_u32(std::string* out, uint32_t v) { out->append(reinterpret_cast<char*>(&v), 4); }
+void put_u64(std::string* out, uint64_t v) { out->append(reinterpret_cast<char*>(&v), 8); }
+
+std::string encode_payload(uint8_t op, uint64_t rv, const uint8_t* key, uint32_t klen,
+                           const uint8_t* val, uint32_t vlen) {
+  std::string payload;
+  payload.reserve(1 + 8 + 4 + 4 + klen + vlen);
+  payload.push_back(char(op));
+  put_u64(&payload, rv);
+  put_u32(&payload, klen);
+  put_u32(&payload, vlen);
+  if (klen) payload.append(reinterpret_cast<const char*>(key), klen);
+  if (vlen) payload.append(reinterpret_cast<const char*>(val), vlen);
+  return payload;
+}
+
+bool append_record(WalStore* s, const std::string& payload) {
+  std::string rec;
+  rec.reserve(8 + payload.size());
+  put_u32(&rec, uint32_t(payload.size()));
+  put_u32(&rec, crc32(reinterpret_cast<const uint8_t*>(payload.data()), payload.size()));
+  rec += payload;
+  const char* p = rec.data();
+  size_t left = rec.size();
+  while (left) {
+    ssize_t n = write(s->fd, p, left);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      return s->fail("write");
+    }
+    p += n;
+    left -= size_t(n);
+  }
+  if (s->sync_every > 0 && ++s->unsynced >= s->sync_every) {
+    if (fsync(s->fd) != 0) return s->fail("fsync");
+    s->unsynced = 0;
+  }
+  return true;
+}
+
+// Replay a record stream from a buffer; returns the offset of the first
+// bad/short record (== buf.size() when everything parsed).
+size_t replay(WalStore* s, const std::string& buf) {
+  size_t off = 0;
+  while (off + 8 <= buf.size()) {
+    uint32_t len, crc;
+    memcpy(&len, buf.data() + off, 4);
+    memcpy(&crc, buf.data() + off + 4, 4);
+    if (off + 8 + len > buf.size()) break;
+    const uint8_t* payload = reinterpret_cast<const uint8_t*>(buf.data()) + off + 8;
+    if (crc32(payload, len) != crc) break;
+    if (len < 1 + 8 + 4 + 4) break;
+    uint8_t op = payload[0];
+    uint64_t rv;
+    uint32_t klen, vlen;
+    memcpy(&rv, payload + 1, 8);
+    memcpy(&klen, payload + 9, 4);
+    memcpy(&vlen, payload + 13, 4);
+    if (17 + uint64_t(klen) + vlen != len) break;
+    std::string key(reinterpret_cast<const char*>(payload) + 17, klen);
+    if (op == OP_PUT) {
+      s->index[key].assign(reinterpret_cast<const char*>(payload) + 17 + klen, vlen);
+    } else if (op == OP_DEL) {
+      s->index.erase(key);
+    }  // OP_META: rv watermark only
+    if (rv > s->rv) s->rv = rv;
+    off += 8 + len;
+  }
+  return off;
+}
+
+bool read_file(const std::string& path, std::string* out) {
+  int fd = open(path.c_str(), O_RDONLY);
+  if (fd < 0) return false;
+  char buf[1 << 16];
+  ssize_t n;
+  while ((n = read(fd, buf, sizeof(buf))) > 0) out->append(buf, size_t(n));
+  close(fd);
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ws_open(const char* path, int sync_every) {
+  auto* s = new WalStore();
+  s->path = path;
+  s->sync_every = sync_every;
+
+  std::string snap;
+  if (read_file(s->path + ".snap", &snap)) replay(s, snap);
+
+  std::string wal;
+  if (read_file(s->path, &wal)) {
+    size_t good = replay(s, wal);
+    if (good < wal.size()) {
+      // torn tail: truncate the file to the last good record
+      if (truncate(path, off_t(good)) != 0) {
+        delete s;
+        return nullptr;
+      }
+    }
+  }
+
+  s->fd = open(path, O_CREAT | O_WRONLY | O_APPEND, 0644);
+  if (s->fd < 0) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+void ws_close(void* h) {
+  auto* s = static_cast<WalStore*>(h);
+  if (!s) return;
+  if (s->fd >= 0) {
+    if (s->unsynced) fsync(s->fd);
+    close(s->fd);
+  }
+  delete s;
+}
+
+const char* ws_last_error(void* h) { return static_cast<WalStore*>(h)->last_error.c_str(); }
+
+int ws_put(void* h, const uint8_t* key, uint32_t klen, const uint8_t* val, uint32_t vlen,
+           uint64_t rv) {
+  auto* s = static_cast<WalStore*>(h);
+  if (!append_record(s, encode_payload(OP_PUT, rv, key, klen, val, vlen))) return -1;
+  s->index[std::string(reinterpret_cast<const char*>(key), klen)].assign(
+      reinterpret_cast<const char*>(val), vlen);
+  if (rv > s->rv) s->rv = rv;
+  return 0;
+}
+
+int ws_del(void* h, const uint8_t* key, uint32_t klen, uint64_t rv) {
+  auto* s = static_cast<WalStore*>(h);
+  if (!append_record(s, encode_payload(OP_DEL, rv, key, klen, nullptr, 0))) return -1;
+  s->index.erase(std::string(reinterpret_cast<const char*>(key), klen));
+  if (rv > s->rv) s->rv = rv;
+  return 0;
+}
+
+int ws_get(void* h, const uint8_t* key, uint32_t klen, const uint8_t** val, uint32_t* vlen) {
+  auto* s = static_cast<WalStore*>(h);
+  auto it = s->index.find(std::string(reinterpret_cast<const char*>(key), klen));
+  if (it == s->index.end()) return 0;
+  *val = reinterpret_cast<const uint8_t*>(it->second.data());
+  *vlen = uint32_t(it->second.size());
+  return 1;
+}
+
+uint64_t ws_rv(void* h) { return static_cast<WalStore*>(h)->rv; }
+uint64_t ws_count(void* h) { return static_cast<WalStore*>(h)->index.size(); }
+
+int ws_flush(void* h) {
+  auto* s = static_cast<WalStore*>(h);
+  if (s->fd >= 0 && fsync(s->fd) != 0) return -1;
+  s->unsynced = 0;
+  return 0;
+}
+
+int ws_snapshot(void* h) {
+  auto* s = static_cast<WalStore*>(h);
+  std::string tmp_path = s->path + ".snap.tmp";
+  int fd = open(tmp_path.c_str(), O_CREAT | O_WRONLY | O_TRUNC, 0644);
+  if (fd < 0) return -1;
+
+  std::string buf;
+  auto emit = [&](const std::string& payload) {
+    put_u32(&buf, uint32_t(payload.size()));
+    put_u32(&buf, crc32(reinterpret_cast<const uint8_t*>(payload.data()), payload.size()));
+    buf += payload;
+  };
+  emit(encode_payload(OP_META, s->rv, nullptr, 0, nullptr, 0));
+  for (const auto& [k, v] : s->index) {
+    emit(encode_payload(OP_PUT, 0, reinterpret_cast<const uint8_t*>(k.data()),
+                        uint32_t(k.size()), reinterpret_cast<const uint8_t*>(v.data()),
+                        uint32_t(v.size())));
+  }
+  const char* p = buf.data();
+  size_t left = buf.size();
+  while (left) {
+    ssize_t n = write(fd, p, left);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      close(fd);
+      unlink(tmp_path.c_str());
+      return -1;
+    }
+    p += n;
+    left -= size_t(n);
+  }
+  if (fsync(fd) != 0 || close(fd) != 0) return -1;
+  if (rename(tmp_path.c_str(), (s->path + ".snap").c_str()) != 0) return -1;
+
+  // truncate the WAL: everything live is now in the snapshot
+  if (s->fd >= 0) close(s->fd);
+  s->fd = open(s->path.c_str(), O_CREAT | O_WRONLY | O_TRUNC | O_APPEND, 0644);
+  s->unsynced = 0;
+  return s->fd >= 0 ? 0 : -1;
+}
+
+void* ws_scan(void* h, const uint8_t* prefix, uint32_t plen) {
+  auto* s = static_cast<WalStore*>(h);
+  auto* c = new Scan();
+  c->store = s;
+  c->prefix.assign(reinterpret_cast<const char*>(prefix), plen);
+  c->it = s->index.lower_bound(c->prefix);
+  return c;
+}
+
+int ws_scan_next(void* cur, const uint8_t** key, uint32_t* klen, const uint8_t** val,
+                 uint32_t* vlen) {
+  auto* c = static_cast<Scan*>(cur);
+  if (c->it == c->store->index.end()) return 0;
+  const std::string& k = c->it->first;
+  if (k.compare(0, c->prefix.size(), c->prefix) != 0) return 0;
+  *key = reinterpret_cast<const uint8_t*>(k.data());
+  *klen = uint32_t(k.size());
+  *val = reinterpret_cast<const uint8_t*>(c->it->second.data());
+  *vlen = uint32_t(c->it->second.size());
+  ++c->it;
+  return 1;
+}
+
+void ws_scan_free(void* cur) { delete static_cast<Scan*>(cur); }
+
+}  // extern "C"
