@@ -63,6 +63,14 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.ws_flush.argtypes = [ctypes.c_void_p]
     lib.ws_snapshot.restype = ctypes.c_int
     lib.ws_snapshot.argtypes = [ctypes.c_void_p]
+    lib.ws_snapshot_begin.restype = ctypes.c_int
+    lib.ws_snapshot_begin.argtypes = [ctypes.c_void_p]
+    lib.ws_snapshot_add.restype = ctypes.c_int
+    lib.ws_snapshot_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+                                    ctypes.c_char_p, ctypes.c_uint32]
+    lib.ws_snapshot_commit.restype = ctypes.c_int
+    lib.ws_snapshot_commit.argtypes = [ctypes.c_void_p]
+    lib.ws_index_release.argtypes = [ctypes.c_void_p]
     lib.ws_scan.restype = ctypes.c_void_p
     lib.ws_scan.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32]
     lib.ws_scan_next.restype = ctypes.c_int
@@ -167,6 +175,22 @@ class WalEngine:
         if self._lib.ws_snapshot(self._h) != 0:
             raise OSError("snapshot failed")
 
+    def snapshot_stream(self, items) -> None:
+        """Compact by streaming (key, value) pairs from the caller —
+        used in journal-only mode where the engine keeps no value copy."""
+        if self._lib.ws_snapshot_begin(self._h) != 0:
+            raise OSError("snapshot begin failed")
+        for key, val in items:
+            if self._lib.ws_snapshot_add(self._h, key, len(key), val, len(val)) != 0:
+                raise OSError("snapshot add failed")
+        if self._lib.ws_snapshot_commit(self._h) != 0:
+            raise OSError("snapshot commit failed")
+
+    def release_index(self) -> None:
+        """Switch to journal-only mode: drop the engine's in-memory copy
+        (the host holds the authoritative objects; get/scan go dark)."""
+        self._lib.ws_index_release(self._h)
+
     def scan(self, prefix: bytes = b"") -> Iterator[tuple[bytes, bytes]]:
         cur = self._lib.ws_scan(self._h, prefix, len(prefix))
         try:
@@ -206,12 +230,16 @@ class NativeBucket:
         """
         import numpy as np
 
+        if out.size < self.capacity:
+            raise ValueError(
+                f"out has {out.size} elements; bucket capacity is {self.capacity}"
+            )
         direct = out.flags["C_CONTIGUOUS"] and out.dtype == np.uint32
         buf = out if direct else np.zeros(self.capacity, dtype=np.uint32)
         ptr = buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32))
         rc = self._lib.enc_bucket_encode(self._h, json_bytes, len(json_bytes), ptr)
         if not direct and rc == 0:
-            out[:] = buf
+            out[: self.capacity] = buf
         return rc
 
     @property

@@ -213,23 +213,30 @@ def _wal_key(key: Key) -> bytes:
     return "\x00".join(key).encode("utf-8")
 
 
-def _detect_wal_format(path: str) -> str | None:
-    """Sniff an existing WAL's format: "json" (JSON-lines), "native"
-    (binary records), or None (absent/empty — either works).
+_WAL_MAGIC = b"KCPWAL1\n"  # stamped by native/walstore.cc on every file
 
-    JSON-lines records always start with ``{"op":``; binary records start
-    with a little-endian u32 length whose first byte is never ``{`` for
-    any record under ~2GB with sane sizes (0x7B as the low length byte is
-    possible, so the JSON probe is authoritative, not the binary one).
+
+def _detect_wal_format(path: str) -> str | None:
+    """Detect an existing WAL's format: "json" (JSON-lines), "native"
+    (binary, identified by its magic header), or None (absent/empty).
+
+    The magic header is authoritative — a binary record length whose low
+    byte happens to be 0x7B ('{') must never read as JSON. JSON-lines
+    files (which always start with ``{"op":`` or a ``{`` snapshot) are
+    recognized explicitly; any other nonempty content is treated as
+    native so the engine's CRC replay (which tolerates legacy
+    magic-less files) gets to decide.
     """
     for candidate in (path, path + ".snap"):
         try:
             with open(candidate, "rb") as f:
-                head = f.read(16)
+                head = f.read(len(_WAL_MAGIC))
         except OSError:
             continue
         if not head:
             continue
+        if head == _WAL_MAGIC:
+            return "native"
         return "json" if head.lstrip()[:1] == b"{" else "native"
     return None
 
@@ -258,6 +265,8 @@ class LogicalStore:
         self._engine = None
         self._engine_mutations = 0
         self._engine_snapshot_every = 50_000
+        if wal_backend not in ("auto", "native", "json"):
+            raise InvalidError(f"unknown wal_backend {wal_backend!r} (auto|native|json)")
         if wal_path:
             existing = _detect_wal_format(wal_path)
             if wal_backend == "auto":
@@ -551,8 +560,7 @@ class LogicalStore:
                 self._engine.delete(key, rec["rv"])
             self._engine_mutations += 1
             if self._engine_mutations >= self._engine_snapshot_every:
-                self._engine.snapshot()
-                self._engine_mutations = 0
+                self.snapshot()
             return
         if self._wal is None or self._wal.fh is None:
             return
@@ -568,6 +576,9 @@ class LogicalStore:
             parts = tuple(key.decode("utf-8").split("\x00"))
             self._objects[parts] = json.loads(val)
         self._rv = self._engine.rv
+        # journal-only mode: this store holds the authoritative objects,
+        # so the engine's duplicate value map would only double memory
+        self._engine.release_index()
 
     def _load_wal(self) -> None:
         assert self._wal is not None
@@ -595,7 +606,11 @@ class LogicalStore:
     def snapshot(self) -> None:
         """Write a snapshot and truncate the WAL (etcd compaction analog)."""
         if self._engine is not None:
-            self._engine.snapshot()
+            self._engine.snapshot_stream(
+                (_wal_key(k), json.dumps(v, separators=(",", ":")).encode("utf-8"))
+                for k, v in self._objects.items()
+            )
+            self._engine_mutations = 0
             return
         if self._wal is None:
             return

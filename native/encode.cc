@@ -52,28 +52,13 @@ struct Bucket {
   }
 };
 
+using kcpnative::sorted_entries;  // shared with json_canon: one key order
+
 uint32_t hash_jvalue(const JValue& v) {
   std::string canon;
   kcpnative::json_canon(v, &canon);
   uint32_t h = fnv1a(reinterpret_cast<const uint8_t*>(canon.data()), canon.size());
   return h ? h : 1;  // 0 is the "absent" sentinel in encoded tensors
-}
-
-// Sorted key order over an object's entries (duplicates keep last, as
-// json.loads does).
-std::vector<const std::pair<std::string, JValue>*> sorted_entries(const JValue& obj) {
-  std::vector<const std::pair<std::string, JValue>*> entries;
-  entries.reserve(obj.obj.size());
-  for (const auto& e : obj.obj) entries.push_back(&e);
-  std::stable_sort(entries.begin(), entries.end(),
-                   [](const auto* a, const auto* b) { return a->first < b->first; });
-  std::vector<const std::pair<std::string, JValue>*> out;
-  out.reserve(entries.size());
-  for (size_t i = 0; i < entries.size(); i++) {
-    if (i + 1 < entries.size() && entries[i]->first == entries[i + 1]->first) continue;
-    out.push_back(entries[i]);
-  }
-  return out;
 }
 
 // Returns false on slot overflow.

@@ -255,6 +255,23 @@ void write_escaped(const std::string& s, std::string* out) {
 
 }  // namespace
 
+std::vector<const std::pair<std::string, JValue>*> sorted_entries(const JValue& v) {
+  // sort by key bytes (== Python's code-point sort for UTF-8);
+  // duplicate keys keep the last occurrence, like json.loads
+  std::vector<const std::pair<std::string, JValue>*> entries;
+  entries.reserve(v.obj.size());
+  for (const auto& e : v.obj) entries.push_back(&e);
+  std::stable_sort(entries.begin(), entries.end(),
+                   [](const auto* a, const auto* b) { return a->first < b->first; });
+  std::vector<const std::pair<std::string, JValue>*> out;
+  out.reserve(entries.size());
+  for (size_t i = 0; i < entries.size(); i++) {
+    if (i + 1 < entries.size() && entries[i]->first == entries[i + 1]->first) continue;
+    out.push_back(entries[i]);
+  }
+  return out;
+}
+
 bool json_parse(const char* data, size_t len, JValue* out, std::string* err) {
   Parser parser{data, data + len, err};
   if (!parser.parse_value(out, 0)) return false;
@@ -282,22 +299,14 @@ void json_canon(const JValue& v, std::string* out) {
       break;
     }
     case JValue::Obj: {
-      // sort by key bytes (== Python's code-point sort for UTF-8);
-      // duplicate keys keep the last occurrence, like json.loads
-      std::vector<const std::pair<std::string, JValue>*> entries;
-      entries.reserve(v.obj.size());
-      for (const auto& e : v.obj) entries.push_back(&e);
-      std::stable_sort(entries.begin(), entries.end(),
-                       [](const auto* a, const auto* b) { return a->first < b->first; });
       out->push_back('{');
       bool first = true;
-      for (size_t i = 0; i < entries.size(); i++) {
-        if (i + 1 < entries.size() && entries[i]->first == entries[i + 1]->first) continue;
+      for (const auto* e : sorted_entries(v)) {
         if (!first) out->push_back(',');
         first = false;
-        write_escaped(entries[i]->first, out);
+        write_escaped(e->first, out);
         out->push_back(':');
-        json_canon(entries[i]->second, out);
+        json_canon(e->second, out);
       }
       out->push_back('}');
       break;

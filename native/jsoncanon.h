@@ -30,4 +30,10 @@ bool json_parse(const char* data, size_t len, JValue* out, std::string* err);
 // ensure_ascii=False escaping) to *out.
 void json_canon(const JValue& v, std::string* out);
 
+// An object's entries sorted by key bytes with duplicate keys keeping
+// the last occurrence (json.loads semantics). The single source of key
+// ordering for both canonicalization and path enumeration — the
+// hash-parity invariant requires those to agree exactly.
+std::vector<const std::pair<std::string, JValue>*> sorted_entries(const JValue& obj);
+
 }  // namespace kcpnative

@@ -43,7 +43,21 @@ int ws_get(void* h, const uint8_t* key, uint32_t klen, const uint8_t** val, uint
 uint64_t ws_rv(void* h);
 uint64_t ws_count(void* h);
 int ws_flush(void* h);     // fsync now
-int ws_snapshot(void* h);  // write snapshot, truncate WAL (compaction)
+int ws_snapshot(void* h);  // write snapshot from the engine index, truncate WAL
+
+// Streaming snapshot: the caller supplies the live objects (so the
+// engine need not keep its own copy of values — see ws_index_release).
+// begin -> add per object -> commit (atomic rename + WAL truncate).
+// A failed add/commit aborts and removes the tmp file.
+int ws_snapshot_begin(void* h);
+int ws_snapshot_add(void* h, const uint8_t* key, uint32_t klen, const uint8_t* val,
+                    uint32_t vlen);
+int ws_snapshot_commit(void* h);
+
+// Journal-only mode: drop the in-memory index (the host keeps the
+// authoritative object map). ws_get/ws_scan return nothing and
+// ws_snapshot fails after this; use the streaming snapshot API.
+void ws_index_release(void* h);
 
 // Ordered prefix scan (etcd range-scan analog over the
 // /<resource>/<cluster>/<ns>/<name> keyspace). Cursor is invalidated

@@ -3,13 +3,20 @@
 // kcp_tpu.store.LogicalStore journals through this engine instead and
 // keeps watch/event semantics host-side in Python).
 //
-// On-disk format (little-endian), one record per mutation:
+// On-disk format (little-endian): an 8-byte magic header "KCPWAL1\n"
+// (so format detection never depends on heuristics — a record length
+// whose low byte happens to be 0x7B ('{') must not read as JSON), then
+// one record per mutation:
 //   [u32 payload_len][u32 crc32(payload)][payload]
 //   payload = u8 op | u64 rv | u32 klen | u32 vlen | key | val
 //   op: 1 = put, 2 = del, 3 = meta (rv watermark, empty key/val)
 // Replay stops at the first short/corrupt record and truncates the file
 // there (torn-write recovery). Snapshot compaction writes the full
 // ordered map into <path>.snap (atomic rename) and truncates the WAL.
+// The streaming snapshot API (ws_snapshot_begin/add/commit) lets the
+// caller supply the live objects itself, which permits journal-only
+// mode (ws_index_release) where the engine keeps no in-memory copy of
+// values the host already holds.
 #include "kcpnative.h"
 
 #include <fcntl.h>
@@ -32,13 +39,19 @@ constexpr uint8_t OP_PUT = 1;
 constexpr uint8_t OP_DEL = 2;
 constexpr uint8_t OP_META = 3;
 
+constexpr char MAGIC[8] = {'K', 'C', 'P', 'W', 'A', 'L', '1', '\n'};
+
 struct WalStore {
   std::string path;
   int fd = -1;
   int sync_every = 256;
   int unsynced = 0;
   uint64_t rv = 0;
+  bool index_enabled = true;
   std::map<std::string, std::string> index;  // ordered: prefix scans
+  // streaming snapshot in progress (ws_snapshot_begin/add/commit)
+  int snap_fd = -1;
+  std::string snap_buf;
   std::string last_error;
 
   bool fail(const std::string& msg) {
@@ -97,6 +110,8 @@ bool append_record(WalStore* s, const std::string& payload) {
 // bad/short record (== buf.size() when everything parsed).
 size_t replay(WalStore* s, const std::string& buf) {
   size_t off = 0;
+  if (buf.size() >= sizeof(MAGIC) && memcmp(buf.data(), MAGIC, sizeof(MAGIC)) == 0)
+    off = sizeof(MAGIC);
   while (off + 8 <= buf.size()) {
     uint32_t len, crc;
     memcpy(&len, buf.data() + off, 4);
@@ -163,6 +178,14 @@ void* ws_open(const char* path, int sync_every) {
     delete s;
     return nullptr;
   }
+  struct stat st;
+  if (fstat(s->fd, &st) == 0 && st.st_size == 0) {
+    if (write(s->fd, MAGIC, sizeof(MAGIC)) != ssize_t(sizeof(MAGIC))) {
+      close(s->fd);
+      delete s;
+      return nullptr;
+    }
+  }
   return s;
 }
 
@@ -182,8 +205,9 @@ int ws_put(void* h, const uint8_t* key, uint32_t klen, const uint8_t* val, uint3
            uint64_t rv) {
   auto* s = static_cast<WalStore*>(h);
   if (!append_record(s, encode_payload(OP_PUT, rv, key, klen, val, vlen))) return -1;
-  s->index[std::string(reinterpret_cast<const char*>(key), klen)].assign(
-      reinterpret_cast<const char*>(val), vlen);
+  if (s->index_enabled)
+    s->index[std::string(reinterpret_cast<const char*>(key), klen)].assign(
+        reinterpret_cast<const char*>(val), vlen);
   if (rv > s->rv) s->rv = rv;
   return 0;
 }
@@ -191,7 +215,8 @@ int ws_put(void* h, const uint8_t* key, uint32_t klen, const uint8_t* val, uint3
 int ws_del(void* h, const uint8_t* key, uint32_t klen, uint64_t rv) {
   auto* s = static_cast<WalStore*>(h);
   if (!append_record(s, encode_payload(OP_DEL, rv, key, klen, nullptr, 0))) return -1;
-  s->index.erase(std::string(reinterpret_cast<const char*>(key), klen));
+  if (s->index_enabled)
+    s->index.erase(std::string(reinterpret_cast<const char*>(key), klen));
   if (rv > s->rv) s->rv = rv;
   return 0;
 }
@@ -215,45 +240,108 @@ int ws_flush(void* h) {
   return 0;
 }
 
-int ws_snapshot(void* h) {
-  auto* s = static_cast<WalStore*>(h);
-  std::string tmp_path = s->path + ".snap.tmp";
-  int fd = open(tmp_path.c_str(), O_CREAT | O_WRONLY | O_TRUNC, 0644);
-  if (fd < 0) return -1;
+namespace {
 
-  std::string buf;
-  auto emit = [&](const std::string& payload) {
-    put_u32(&buf, uint32_t(payload.size()));
-    put_u32(&buf, crc32(reinterpret_cast<const uint8_t*>(payload.data()), payload.size()));
-    buf += payload;
-  };
-  emit(encode_payload(OP_META, s->rv, nullptr, 0, nullptr, 0));
-  for (const auto& [k, v] : s->index) {
-    emit(encode_payload(OP_PUT, 0, reinterpret_cast<const uint8_t*>(k.data()),
-                        uint32_t(k.size()), reinterpret_cast<const uint8_t*>(v.data()),
-                        uint32_t(v.size())));
-  }
+void emit_record(std::string* buf, const std::string& payload) {
+  put_u32(buf, uint32_t(payload.size()));
+  put_u32(buf, crc32(reinterpret_cast<const uint8_t*>(payload.data()), payload.size()));
+  *buf += payload;
+}
+
+bool write_all(int fd, const std::string& buf) {
   const char* p = buf.data();
   size_t left = buf.size();
   while (left) {
     ssize_t n = write(fd, p, left);
     if (n < 0) {
       if (errno == EINTR) continue;
-      close(fd);
-      unlink(tmp_path.c_str());
-      return -1;
+      return false;
     }
     p += n;
     left -= size_t(n);
   }
-  if (fsync(fd) != 0 || close(fd) != 0) return -1;
-  if (rename(tmp_path.c_str(), (s->path + ".snap").c_str()) != 0) return -1;
+  return true;
+}
 
+void abort_snapshot(WalStore* s) {
+  if (s->snap_fd >= 0) close(s->snap_fd);
+  s->snap_fd = -1;
+  s->snap_buf.clear();
+  unlink((s->path + ".snap.tmp").c_str());
+}
+
+// Commit whatever is buffered in snap_buf/snap_fd: flush, fsync, atomic
+// rename, truncate the live WAL (re-stamping its magic header).
+int commit_snapshot(WalStore* s) {
+  int fd = s->snap_fd;
+  s->snap_fd = -1;
+  bool ok = write_all(fd, s->snap_buf);
+  s->snap_buf.clear();
+  ok = ok && fsync(fd) == 0;
+  ok = close(fd) == 0 && ok;  // close unconditionally, even after failure
+  if (!ok || rename((s->path + ".snap.tmp").c_str(), (s->path + ".snap").c_str()) != 0) {
+    unlink((s->path + ".snap.tmp").c_str());
+    return -1;
+  }
   // truncate the WAL: everything live is now in the snapshot
   if (s->fd >= 0) close(s->fd);
   s->fd = open(s->path.c_str(), O_CREAT | O_WRONLY | O_TRUNC | O_APPEND, 0644);
   s->unsynced = 0;
-  return s->fd >= 0 ? 0 : -1;
+  if (s->fd < 0) return -1;
+  if (write(s->fd, MAGIC, sizeof(MAGIC)) != ssize_t(sizeof(MAGIC))) return -1;
+  return 0;
+}
+
+}  // namespace
+
+int ws_snapshot(void* h) {
+  auto* s = static_cast<WalStore*>(h);
+  if (!s->index_enabled) return -1;  // journal-only mode: use the streaming API
+  if (ws_snapshot_begin(h) != 0) return -1;
+  for (const auto& [k, v] : s->index) {
+    emit_record(&s->snap_buf,
+                encode_payload(OP_PUT, 0, reinterpret_cast<const uint8_t*>(k.data()),
+                               uint32_t(k.size()), reinterpret_cast<const uint8_t*>(v.data()),
+                               uint32_t(v.size())));
+  }
+  return commit_snapshot(s);
+}
+
+int ws_snapshot_begin(void* h) {
+  auto* s = static_cast<WalStore*>(h);
+  if (s->snap_fd >= 0) abort_snapshot(s);
+  s->snap_fd = open((s->path + ".snap.tmp").c_str(), O_CREAT | O_WRONLY | O_TRUNC, 0644);
+  if (s->snap_fd < 0) return -1;
+  s->snap_buf.assign(MAGIC, sizeof(MAGIC));
+  emit_record(&s->snap_buf, encode_payload(OP_META, s->rv, nullptr, 0, nullptr, 0));
+  return 0;
+}
+
+int ws_snapshot_add(void* h, const uint8_t* key, uint32_t klen, const uint8_t* val,
+                    uint32_t vlen) {
+  auto* s = static_cast<WalStore*>(h);
+  if (s->snap_fd < 0) return -1;
+  emit_record(&s->snap_buf, encode_payload(OP_PUT, 0, key, klen, val, vlen));
+  if (s->snap_buf.size() >= (1u << 20)) {  // stream out in ~1MB slabs
+    if (!write_all(s->snap_fd, s->snap_buf)) {
+      abort_snapshot(s);
+      return -1;
+    }
+    s->snap_buf.clear();
+  }
+  return 0;
+}
+
+int ws_snapshot_commit(void* h) {
+  auto* s = static_cast<WalStore*>(h);
+  if (s->snap_fd < 0) return -1;
+  return commit_snapshot(s);
+}
+
+void ws_index_release(void* h) {
+  auto* s = static_cast<WalStore*>(h);
+  s->index_enabled = false;
+  s->index.clear();
 }
 
 void* ws_scan(void* h, const uint8_t* prefix, uint32_t plen) {

@@ -199,7 +199,7 @@ class TestWalEngine:
         for i in range(100):
             w.put(f"k{i:03}".encode(), b"x" * 50, i + 1)
         w.snapshot()
-        assert os.path.getsize(p) == 0  # WAL truncated
+        assert os.path.getsize(p) == 8  # WAL truncated to magic header
         w.put(b"post", b"y", 101)
         w.close()
 
@@ -290,6 +290,45 @@ class TestStoreWithNativeWal:
         s2 = LogicalStore(wal_path=p, wal_backend="native")
         assert len(s2) == 25
         s2.close()
+
+    def test_journal_mode_streaming_snapshot_roundtrip(self, tmp_path):
+        # after restore the engine drops its value copy (journal-only
+        # mode); snapshots must still work by streaming from the store
+        from kcp_tpu.store.store import LogicalStore
+
+        p = str(tmp_path / "store.wal")
+        s = LogicalStore(wal_path=p, wal_backend="native")
+        for i in range(10):
+            s.create("configmaps", "root", {"metadata": {"name": f"cm{i}"}}, "ns")
+        s.close()
+
+        s2 = LogicalStore(wal_path=p, wal_backend="native")  # loads + releases index
+        s2.create("configmaps", "root", {"metadata": {"name": "post"}}, "ns")
+        s2.snapshot()  # must stream from host objects, not the engine index
+        s2.delete("configmaps", "root", "cm0", "ns")
+        s2.close()
+
+        s3 = LogicalStore(wal_path=p, wal_backend="native")
+        assert len(s3) == 10  # 10 originals + post - cm0
+        assert s3.get("configmaps", "root", "post", "ns")
+        s3.close()
+
+    def test_magic_header_never_misreads_as_json(self, tmp_path):
+        # a native WAL whose first record length byte is 0x7B ('{') must
+        # still be detected as native thanks to the magic header
+        from kcp_tpu.store.store import _detect_wal_format
+
+        p = str(tmp_path / "s.wal")
+        from kcp_tpu.native import WalEngine
+
+        w = WalEngine(p)
+        # payload length 123 = 17 header + 20 key + 86 value
+        w.put(b"k" * 20, b"v" * 86, 1)
+        w.close()
+        assert _detect_wal_format(p) == "native"
+        w2 = WalEngine(p)
+        assert w2.get(b"k" * 20) == b"v" * 86
+        w2.close()
 
     def test_store_native_snapshot(self, tmp_path):
         from kcp_tpu.store.store import LogicalStore
