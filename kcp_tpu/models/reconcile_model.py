@@ -118,8 +118,9 @@ def reconcile_step(state: ReconcileState, deltas: ReconcileDeltas,
     leaf = split_replicas(state.replicas, state.avail)
     p_dirty = placement_changed(state.current, leaf)
 
-    # 4. informer fan-out lane
-    match = fanout_match(state.pair_hashes, state.sel_hashes)  # [B, C]
+    # 4. informer fan-out lane — only resident upstream objects fan out
+    #    (pair_hashes rows of deleted objects are stale, not cleared)
+    match = fanout_match(state.pair_hashes, state.sel_hashes) & up_exists[:, None]  # [B, C]
     match_counts = match.sum(axis=0, dtype=jnp.int32)
 
     # 5. global stats — under a sharded mesh these reductions lower to

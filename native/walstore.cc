@@ -66,6 +66,8 @@ struct Scan {
   std::string prefix;
 };
 
+void abort_snapshot(WalStore* s);  // defined with the snapshot helpers below
+
 void put_u32(std::string* out, uint32_t v) { out->append(reinterpret_cast<char*>(&v), 4); }
 void put_u64(std::string* out, uint64_t v) { out->append(reinterpret_cast<char*>(&v), 8); }
 
@@ -192,6 +194,7 @@ void* ws_open(const char* path, int sync_every) {
 void ws_close(void* h) {
   auto* s = static_cast<WalStore*>(h);
   if (!s) return;
+  if (s->snap_fd >= 0) abort_snapshot(s);  // caller died mid-stream
   if (s->fd >= 0) {
     if (s->unsynced) fsync(s->fd);
     close(s->fd);
@@ -239,6 +242,8 @@ int ws_flush(void* h) {
   s->unsynced = 0;
   return 0;
 }
+
+}  // extern "C"
 
 namespace {
 
@@ -293,6 +298,8 @@ int commit_snapshot(WalStore* s) {
 }
 
 }  // namespace
+
+extern "C" {
 
 int ws_snapshot(void* h) {
   auto* s = static_cast<WalStore*>(h);
