@@ -78,6 +78,11 @@ class RestHandler:
             return Response(status=500, body=b"not ready", content_type="text/plain")
         if head == "version":
             return Response.of_json(self.version_info)
+        if head == "metrics":
+            from ..utils.trace import REGISTRY
+
+            return Response(body=REGISTRY.expose().encode("utf-8"),
+                            content_type="text/plain; version=0.0.4")
         if head == "api":
             return await self._route_group(req, cluster, group="", segs=segs[1:])
         if head == "apis":

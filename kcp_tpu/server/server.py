@@ -81,6 +81,9 @@ class Server:
         for hook in self._post_start_hooks:
             await hook(self)
         self.handler.ready = True
+        from ..utils.trace import REGISTRY
+
+        REGISTRY.gauge("kcp_up", "1 once post-start hooks completed").set(1)
         log.info("kcp-tpu serving at %s", self.address)
 
     async def _install_controllers(self) -> None:
@@ -92,6 +95,7 @@ class Server:
         from ..reconcilers.cluster import ClusterController, SyncerMode
         from ..reconcilers.crdlifecycle import CRDLifecycleController
         from ..reconcilers.deployment import DeploymentSplitter
+        from ..reconcilers.namespace import NamespaceLifecycleController
 
         mode = {"push": SyncerMode.PUSH, "pull": SyncerMode.PULL,
                 "none": SyncerMode.NONE}[self.config.syncer_mode]
@@ -106,6 +110,9 @@ class Server:
                 import_poll_interval=self.config.import_poll_interval,
             ),
             DeploymentSplitter(self.client),
+            # the reference's "start-namespace-controller" hook
+            # (server.go:325-356)
+            NamespaceLifecycleController(self.client),
         ]
         for c in self._controllers:
             await c.start()

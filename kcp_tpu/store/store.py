@@ -349,6 +349,14 @@ class LogicalStore:
         key = self._key(resource, cluster, namespace, name)
         if key in self._objects:
             raise AlreadyExistsError(f"{resource} {cluster}/{namespace}/{name} already exists")
+        if resource == "namespaces":
+            # admission-style lifecycle finalizer, stamped synchronously at
+            # create (as the real apiserver's NamespaceLifecycle admission
+            # does) so a create+delete race can never skip the content
+            # sweep in reconcilers/namespace.py
+            fins = meta.setdefault("finalizers", [])
+            if "kubernetes" not in fins:
+                fins.append("kubernetes")
         meta["namespace"] = namespace
         meta["clusterName"] = cluster
         meta["uid"] = meta.get("uid") or str(uuid.uuid4())

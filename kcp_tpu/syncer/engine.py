@@ -230,7 +230,19 @@ class BatchSyncEngine:
     # -------------------------------------------------------------- tick
 
     async def _process_batch(self, items: Sequence) -> list[tuple[object, Exception]]:
+        from ..utils.trace import span
+
+        with span("kcp_sync_tick"):
+            return await self._process_batch_timed(items)
+
+    async def _process_batch_timed(self, items: Sequence) -> list[tuple[object, Exception]]:
+        from ..utils.trace import REGISTRY
+
         self.stats["ticks"] += 1
+        REGISTRY.counter("kcp_sync_ticks_total",
+                         "reconcile ticks across all sync sessions").inc()
+        REGISTRY.counter("kcp_sync_events_total",
+                         "informer events drained into tick batches").inc(len(items))
         # 1. dedup keys touched this tick (last event wins — we re-read
         #    caches), remembering which queue items map to each key so
         #    failures are charged to the right items' retry budgets
@@ -271,11 +283,14 @@ class BatchSyncEngine:
         # touched keys that needed no action converged by observation
         act_set = {self.row_keys[r] for r in act_rows if r < n}
         now = time.monotonic()
+        conv_h = REGISTRY.histogram("kcp_sync_convergence_seconds",
+                                    "spec churn to observed convergence")
         for key in key_items:
             if key not in act_set:
                 started = self.dirty_since.pop(key, None)
                 if started is not None:
                     self.convergence_samples.append(now - started)
+                    conv_h.observe(now - started)
         self.stats["rows"] = n
 
         # failures on rows whose items are in this batch charge those

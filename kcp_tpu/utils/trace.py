@@ -1,0 +1,189 @@
+"""Observability: metrics registry, timing spans, device profiling.
+
+The reference has no first-party tracing — its forked apiserver serves
+standard ``/metrics`` and ``/debug/pprof`` endpoints that nothing in the
+repo touches (SURVEY.md §5). For a TPU control plane that is not enough:
+the interesting time is split between host orchestration (asyncio
+controllers, encode, apply) and device ticks (jit dispatch, transfer,
+kernel time), so this module provides
+
+- a process-global :class:`Registry` of counters / gauges / histograms
+  with Prometheus-style text exposition (served at ``/metrics`` by the
+  API server),
+- :func:`span` — a context manager timing a named section into a
+  histogram (host-side structured timing),
+- :func:`device_trace` — a context manager around
+  ``jax.profiler.trace`` emitting an XLA trace directory for
+  TensorBoard/xprof when deeper device attribution is needed.
+
+Everything is dependency-free and safe to call on hot paths: a span is
+two ``perf_counter`` calls and a dict update.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from bisect import bisect_right
+from dataclasses import dataclass, field
+
+_DEFAULT_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+@dataclass
+class Counter:
+    name: str
+    help: str = ""
+    value: float = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        self.value += amount
+
+
+@dataclass
+class Gauge:
+    name: str
+    help: str = ""
+    value: float = 0.0
+
+    def set(self, value: float) -> None:
+        self.value = value
+
+
+@dataclass
+class Histogram:
+    name: str
+    help: str = ""
+    buckets: tuple = _DEFAULT_BUCKETS
+    counts: list = field(default_factory=list)
+    total: float = 0.0
+    n: int = 0
+
+    def __post_init__(self):
+        if not self.counts:
+            self.counts = [0] * (len(self.buckets) + 1)
+
+    def observe(self, value: float) -> None:
+        self.counts[bisect_right(self.buckets, value)] += 1
+        self.total += value
+        self.n += 1
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.n if self.n else 0.0
+
+    def quantile(self, q: float) -> float:
+        """Approximate quantile from bucket boundaries (upper edge)."""
+        if not self.n:
+            return 0.0
+        target = q * self.n
+        seen = 0
+        for i, c in enumerate(self.counts):
+            seen += c
+            if seen >= target:
+                return self.buckets[i] if i < len(self.buckets) else float("inf")
+        return float("inf")
+
+
+class Registry:
+    """Named metrics with Prometheus text exposition."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, Counter | Gauge | Histogram] = {}
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_make(name, lambda: Counter(name, help))
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_make(name, lambda: Gauge(name, help))
+
+    def histogram(self, name: str, help: str = "", buckets: tuple = _DEFAULT_BUCKETS) -> Histogram:
+        return self._get_or_make(name, lambda: Histogram(name, help, buckets))
+
+    def _get_or_make(self, name, factory):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = factory()
+            return m
+
+    def expose(self) -> str:
+        """Prometheus text format (the /metrics body)."""
+        out: list[str] = []
+        with self._lock:
+            for name in sorted(self._metrics):
+                m = self._metrics[name]
+                if m.help:
+                    out.append(f"# HELP {name} {m.help}")
+                if isinstance(m, Counter):
+                    out.append(f"# TYPE {name} counter")
+                    out.append(f"{name} {m.value}")
+                elif isinstance(m, Gauge):
+                    out.append(f"# TYPE {name} gauge")
+                    out.append(f"{name} {m.value}")
+                else:
+                    out.append(f"# TYPE {name} histogram")
+                    cum = 0
+                    for edge, c in zip(m.buckets, m.counts):
+                        cum += c
+                        out.append(f'{name}_bucket{{le="{edge}"}} {cum}')
+                    out.append(f'{name}_bucket{{le="+Inf"}} {m.n}')
+                    out.append(f"{name}_sum {m.total}")
+                    out.append(f"{name}_count {m.n}")
+        return "\n".join(out) + "\n"
+
+    def snapshot(self) -> dict:
+        """Structured dump for tests/logging."""
+        with self._lock:
+            out = {}
+            for name, m in self._metrics.items():
+                if isinstance(m, (Counter, Gauge)):
+                    out[name] = m.value
+                else:
+                    out[name] = {"count": m.n, "mean": m.mean,
+                                 "p50": m.quantile(0.5), "p99": m.quantile(0.99)}
+            return out
+
+
+REGISTRY = Registry()
+
+
+@contextlib.contextmanager
+def span(name: str, registry: Registry = REGISTRY):
+    """Time a section into histogram ``<name>_seconds``."""
+    h = registry.histogram(f"{name}_seconds")
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        h.observe(time.perf_counter() - t0)
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str):
+    """XLA/TPU profiler trace around a block (view with xprof/TensorBoard).
+
+    No-ops cleanly if the profiler cannot start (e.g. another trace is
+    active or the backend does not support it).
+    """
+    import jax
+
+    started = False
+    try:
+        jax.profiler.start_trace(log_dir)
+        started = True
+    except Exception:
+        pass
+    try:
+        yield
+    finally:
+        if started:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass

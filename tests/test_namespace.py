@@ -1,0 +1,204 @@
+"""Namespace lifecycle controller tests.
+
+Covers the reference's namespace-controller semantics (wired at
+pkg/server/server.go:325-356): finalizer stamping, content sweep on
+deletion, finalizer release once empty, and per-tenant isolation of the
+sweep across logical clusters.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from kcp_tpu.client import MultiClusterClient
+from kcp_tpu.reconcilers.namespace import FINALIZER, NamespaceLifecycleController
+from kcp_tpu.store import LogicalStore
+from kcp_tpu.utils.errors import NotFoundError
+
+
+async def _settle(predicate, timeout=3.0, interval=0.02):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        if predicate():
+            return True
+        await asyncio.sleep(interval)
+    return predicate()
+
+
+def _absent(store, resource, cluster, name, namespace="") -> bool:
+    try:
+        store.get(resource, cluster, name, namespace)
+        return False
+    except NotFoundError:
+        return True
+
+
+def _has_finalizer(store, cluster, name) -> bool:
+    try:
+        ns = store.get("namespaces", cluster, name)
+    except NotFoundError:
+        return False
+    return FINALIZER in (ns["metadata"].get("finalizers") or [])
+
+
+def test_live_namespace_gains_finalizer():
+    async def main():
+        store = LogicalStore()
+        client = MultiClusterClient(store)
+        ctrl = NamespaceLifecycleController(client)
+        await ctrl.start()
+        try:
+            client.scoped("root").create("namespaces", {"metadata": {"name": "team-a"}})
+            assert await _settle(lambda: _has_finalizer(store, "root", "team-a"))
+        finally:
+            await ctrl.stop()
+
+    asyncio.run(main())
+
+
+def test_deletion_sweeps_contents_then_removes_namespace():
+    async def main():
+        store = LogicalStore()
+        client = MultiClusterClient(store)
+        ctrl = NamespaceLifecycleController(client)
+        await ctrl.start()
+        try:
+            scoped = client.scoped("root")
+            scoped.create("namespaces", {"metadata": {"name": "team-a"}})
+            scoped.create("configmaps",
+                          {"metadata": {"name": "cm1", "namespace": "team-a"}},
+                          namespace="team-a")
+            scoped.create("secrets",
+                          {"metadata": {"name": "s1", "namespace": "team-a"}},
+                          namespace="team-a")
+            await _settle(lambda: _has_finalizer(store, "root", "team-a"))
+
+            scoped.delete("namespaces", "team-a")
+            gone = await _settle(lambda: _absent(store, "namespaces", "root", "team-a"))
+            assert gone, "namespace should disappear once swept"
+            assert _absent(store, "configmaps", "root", "cm1", "team-a")
+            assert _absent(store, "secrets", "root", "s1", "team-a")
+        finally:
+            await ctrl.stop()
+
+    asyncio.run(main())
+
+
+def test_sweep_is_tenant_scoped():
+    async def main():
+        store = LogicalStore()
+        client = MultiClusterClient(store)
+        ctrl = NamespaceLifecycleController(client)
+        await ctrl.start()
+        try:
+            for cluster in ("east", "west"):
+                client.scoped(cluster).create(
+                    "namespaces", {"metadata": {"name": "shared"}})
+                client.scoped(cluster).create(
+                    "configmaps",
+                    {"metadata": {"name": "cm", "namespace": "shared"}},
+                    namespace="shared")
+            await _settle(lambda: _has_finalizer(store, "east", "shared")
+                          and _has_finalizer(store, "west", "shared"))
+
+            client.scoped("east").delete("namespaces", "shared")
+            gone = await _settle(lambda: _absent(store, "namespaces", "east", "shared"))
+            assert gone
+            # the other tenant's namespace and contents are untouched
+            assert store.get("namespaces", "west", "shared")
+            assert store.get("configmaps", "west", "cm", "shared")
+        finally:
+            await ctrl.stop()
+
+    asyncio.run(main())
+
+
+def test_create_delete_race_cannot_orphan_contents():
+    """The store stamps the finalizer synchronously at create, so a
+    delete issued before the controller's first reconcile still sweeps."""
+
+    async def main():
+        store = LogicalStore()
+        client = MultiClusterClient(store)
+        scoped = client.scoped("root")
+        # namespace + contents + delete all BEFORE the controller starts
+        scoped.create("namespaces", {"metadata": {"name": "racy"}})
+        scoped.create("configmaps",
+                      {"metadata": {"name": "cm", "namespace": "racy"}},
+                      namespace="racy")
+        scoped.delete("namespaces", "racy")
+        ns = store.get("namespaces", "root", "racy")
+        assert ns["metadata"]["deletionTimestamp"]  # finalizer held it
+
+        ctrl = NamespaceLifecycleController(client)
+        await ctrl.start()
+        try:
+            assert await _settle(lambda: _absent(store, "namespaces", "root", "racy"))
+            assert _absent(store, "configmaps", "root", "cm", "racy")
+        finally:
+            await ctrl.stop()
+
+    asyncio.run(main())
+
+
+def test_orphaned_contents_swept_after_out_of_band_finalizer_removal():
+    async def main():
+        store = LogicalStore()
+        client = MultiClusterClient(store)
+        ctrl = NamespaceLifecycleController(client)
+        await ctrl.start()
+        try:
+            scoped = client.scoped("root")
+            scoped.create("namespaces", {"metadata": {"name": "ns1"}})
+            scoped.create("configmaps",
+                          {"metadata": {"name": "cm", "namespace": "ns1"}},
+                          namespace="ns1")
+            await _settle(lambda: _has_finalizer(store, "root", "ns1"))
+            # strip the finalizer out of band, then delete: the namespace
+            # vanishes instantly, contents become orphans
+            ns = store.get("namespaces", "root", "ns1")
+            ns["metadata"]["finalizers"] = []
+            scoped.update("namespaces", ns)
+            scoped.delete("namespaces", "ns1")
+            assert _absent(store, "namespaces", "root", "ns1")
+            assert await _settle(
+                lambda: _absent(store, "configmaps", "root", "cm", "ns1"))
+        finally:
+            await ctrl.stop()
+
+    asyncio.run(main())
+
+
+def test_finalizered_content_defers_namespace_removal():
+    async def main():
+        store = LogicalStore()
+        client = MultiClusterClient(store)
+        ctrl = NamespaceLifecycleController(client)
+        await ctrl.start()
+        try:
+            scoped = client.scoped("root")
+            scoped.create("namespaces", {"metadata": {"name": "team-a"}})
+            scoped.create(
+                "configmaps",
+                {"metadata": {"name": "held", "namespace": "team-a",
+                              "finalizers": ["example.dev/hold"]}},
+                namespace="team-a")
+            await _settle(lambda: _has_finalizer(store, "root", "team-a"))
+
+            scoped.delete("namespaces", "team-a")
+            await asyncio.sleep(0.3)
+            # held content -> namespace still terminating, not gone
+            ns = store.get("namespaces", "root", "team-a")
+            assert ns["metadata"].get("deletionTimestamp")
+            held = store.get("configmaps", "root", "held", "team-a")
+            assert held["metadata"].get("deletionTimestamp")
+
+            # release the hold; everything drains
+            held["metadata"]["finalizers"] = []
+            scoped.update("configmaps", held, namespace="team-a")
+            gone = await _settle(lambda: _absent(store, "namespaces", "root", "team-a"))
+            assert gone
+        finally:
+            await ctrl.stop()
+
+    asyncio.run(main())
