@@ -18,9 +18,16 @@ Global convergence statistics (dirty counts, decision histograms) are
 full reductions; under jit with these shardings XLA lowers them to
 psum-style collectives across both axes.
 
-Multi-host: the same mesh spans hosts (jax.distributed); tenants-axis
-blocks map to hosts so informer-delta ingestion stays host-local and
-only the scalar stats cross DCN.
+Multi-host: :func:`make_multihost_mesh` adds an explicit ``hosts`` major
+axis (jax.distributed process boundaries = DCN). Row dimensions then
+fold over ``(hosts, tenants)`` so each host's devices own a contiguous
+tenant block — informer-delta ingestion stays host-local (each host
+scatters only its own tenants' deltas over ICI) and the only traffic
+that crosses DCN is the scalar stats reduction, which XLA lowers to a
+hierarchical psum (intra-host over ICI first, then one small inter-host
+step). That is the whole distributed-communication story of a control
+plane: no weight tensors, no activations — mirrors stay put, scalars
+travel.
 """
 
 from __future__ import annotations
@@ -29,6 +36,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+HOSTS_AXIS = "hosts"
 TENANTS_AXIS = "tenants"
 SLOTS_AXIS = "slots"
 
@@ -60,26 +68,58 @@ def make_mesh(
     return Mesh(arr, (TENANTS_AXIS, SLOTS_AXIS))
 
 
+def make_multihost_mesh(
+    hosts: int,
+    tenants: int | None = None,
+    slots: int = 1,
+    devices: list | None = None,
+) -> Mesh:
+    """A 3D (hosts, tenants, slots) mesh with hosts as the major axis.
+
+    On real multi-host pods, ``devices`` defaults to jax.devices() whose
+    order groups by process — so the major axis maps exactly to DCN
+    boundaries. Single-host tests pass virtual devices and the axis is
+    purely logical (the sharding semantics are identical, which is what
+    the tests pin down).
+    """
+    devs = devices if devices is not None else jax.devices()
+    n = len(devs)
+    if n % hosts:
+        raise ValueError(f"{n} devices not divisible into {hosts} hosts")
+    per_host = n // hosts
+    if tenants is None:
+        tenants = per_host // slots
+    if tenants * slots != per_host:
+        raise ValueError(f"per-host mesh {tenants}x{slots} != {per_host} devices")
+    arr = np.array(devs).reshape(hosts, tenants, slots)
+    return Mesh(arr, (HOSTS_AXIS, TENANTS_AXIS, SLOTS_AXIS))
+
+
 def state_shardings(mesh: Mesh) -> dict[str, NamedSharding]:
     """NamedShardings for the reconcile state pytree (models/reconcile_model).
 
-    rows [B, S]    -> (tenants, slots)
-    flags [B]      -> (tenants,)
+    rows [B, S]    -> ((hosts?, tenants), slots)
+    flags [B]      -> ((hosts?, tenants),)
     slot masks [S] -> (slots,)
-    placement [R,*]-> (tenants, ...)
+    placement [R,*]-> ((hosts?, tenants), ...)
     selector [C]   -> replicated (every device matches its rows against
                       every cluster selector)
+
+    With a :func:`make_multihost_mesh` mesh, row dimensions fold over
+    (hosts, tenants) so tenant blocks nest inside host blocks.
     """
+    row = (HOSTS_AXIS, TENANTS_AXIS) if HOSTS_AXIS in mesh.axis_names else TENANTS_AXIS
+
     def s(*spec):
         return NamedSharding(mesh, P(*spec))
 
     return {
-        "rows": s(TENANTS_AXIS, SLOTS_AXIS),
-        "flags": s(TENANTS_AXIS),
+        "rows": s(row, SLOTS_AXIS),
+        "flags": s(row),
         "slot_mask": s(SLOTS_AXIS),
-        "placement": s(TENANTS_AXIS, None),
-        "placement_rows": s(TENANTS_AXIS),
-        "labels": s(TENANTS_AXIS, None),
+        "placement": s(row, None),
+        "placement_rows": s(row),
+        "labels": s(row, None),
         "selectors": s(),
         "replicated": s(),
     }

@@ -95,8 +95,10 @@ class NamespaceLifecycleController:
             # something stripped it from a live namespace
             if FINALIZER not in finalizers:
                 fresh = scoped.get(NAMESPACES, name)
-                fresh["metadata"].setdefault("finalizers", []).append(FINALIZER)
-                scoped.update(NAMESPACES, fresh)
+                fins = fresh["metadata"].setdefault("finalizers", [])
+                if FINALIZER not in fins:  # re-check: informer copy is stale
+                    fins.append(FINALIZER)
+                    scoped.update(NAMESPACES, fresh)
             return
 
         # terminating: sweep contents, then release the finalizer

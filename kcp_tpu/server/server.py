@@ -53,7 +53,12 @@ class Server:
         if self.config.durable:
             os.makedirs(self.config.root_dir, exist_ok=True)
             wal = os.path.join(self.config.root_dir, "store.wal")
-        self.store = LogicalStore(wal_path=wal)
+        # finalizer stamping is only safe when the namespace controller
+        # that releases it will run (install_controllers)
+        self.store = LogicalStore(
+            wal_path=wal,
+            namespace_lifecycle=self.config.install_controllers,
+        )
         self.handler = RestHandler(self.store, self.scheme)
         self.http = HttpServer(self.handler, self.config.listen_host,
                                self.config.listen_port)

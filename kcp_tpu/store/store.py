@@ -250,12 +250,20 @@ class LogicalStore:
         clock: Callable[[], float] = time.time,
         wal_backend: str = "auto",
         wal_sync_every: int = 256,
+        namespace_lifecycle: bool = False,
     ):
         """``wal_backend``: "auto" uses the native C++ engine
         (native/walstore.cc — binary records, CRC32 torn-write recovery,
         batched fsync) when the library loads, else the JSON-lines
         fallback; "native"/"json" force a choice.
+
+        ``namespace_lifecycle``: stamp the ``kubernetes`` finalizer on
+        namespaces at create (admission-style). Only enable where a
+        NamespaceLifecycleController will actually release it — the kcp
+        server does; bare stores and physical-cluster fakes must not,
+        or their namespaces can never finish deleting.
         """
+        self.namespace_lifecycle = namespace_lifecycle
         self._objects: dict[Key, dict] = {}
         self._rv = 0
         self._watches: list[Watch] = []
@@ -349,7 +357,7 @@ class LogicalStore:
         key = self._key(resource, cluster, namespace, name)
         if key in self._objects:
             raise AlreadyExistsError(f"{resource} {cluster}/{namespace}/{name} already exists")
-        if resource == "namespaces":
+        if resource == "namespaces" and self.namespace_lifecycle:
             # admission-style lifecycle finalizer, stamped synchronously at
             # create (as the real apiserver's NamespaceLifecycle admission
             # does) so a create+delete race can never skip the content

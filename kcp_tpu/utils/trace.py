@@ -25,7 +25,7 @@ from __future__ import annotations
 import contextlib
 import threading
 import time
-from bisect import bisect_right
+from bisect import bisect_left
 from dataclasses import dataclass, field
 
 _DEFAULT_BUCKETS = (
@@ -68,7 +68,9 @@ class Histogram:
             self.counts = [0] * (len(self.buckets) + 1)
 
     def observe(self, value: float) -> None:
-        self.counts[bisect_right(self.buckets, value)] += 1
+        # bisect_left: an observation equal to a bucket edge belongs in
+        # that bucket (Prometheus's inclusive `le` semantics)
+        self.counts[bisect_left(self.buckets, value)] += 1
         self.total += value
         self.n += 1
 

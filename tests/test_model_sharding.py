@@ -13,7 +13,12 @@ from kcp_tpu.models.reconcile_model import (
     reconcile_step,
 )
 from kcp_tpu.ops.diff import DECISION_UPDATE
-from kcp_tpu.parallel.mesh import make_mesh, shard_state, state_sharding_tree
+from kcp_tpu.parallel.mesh import (
+    make_mesh,
+    make_multihost_mesh,
+    shard_state,
+    state_sharding_tree,
+)
 
 
 def test_step_decisions_match_mirror_contents():
@@ -96,6 +101,39 @@ def test_sharded_step_matches_single_device(slots_dim):
                                   np.asarray(ref_state.up_vals))
     # the sharding actually took: row-dim sharded over the tenants axis
     assert not new_state.up_vals.sharding.is_fully_replicated
+
+
+@pytest.mark.parametrize("hosts,slots_dim", [(2, 1), (2, 2), (4, 1)])
+def test_multihost_sharded_step_matches_single_device(hosts, slots_dim):
+    """3-axis (hosts, tenants, slots) mesh: the DCN-shaped layout must be
+    numerically identical to single-device; rows fold over (hosts,
+    tenants) so each host owns a contiguous tenant block."""
+    n = 8
+    assert len(jax.devices()) >= n
+    mesh = make_multihost_mesh(hosts=hosts, slots=slots_dim,
+                               devices=jax.devices()[:n])
+    b, s = 256, 32
+    host_state = example_state(b=b, s=s, r=32, p=4, l=4, c=8, dirty_frac=0.05)
+    host_deltas = example_deltas(b=b, s=s, d=16)
+
+    ref_state, ref_out = jax.jit(reconcile_step)(host_state, host_deltas)
+
+    sharded = shard_state(host_state, mesh)
+    repl = NamedSharding(mesh, P())
+    deltas = ReconcileDeltas(*(jax.device_put(np.asarray(x), repl) for x in host_deltas))
+    out_shardings = (state_sharding_tree(mesh), None)
+    new_state, out = jax.jit(reconcile_step, out_shardings=out_shardings)(sharded, deltas)
+
+    np.testing.assert_array_equal(np.asarray(out.decision), np.asarray(ref_out.decision))
+    np.testing.assert_array_equal(np.asarray(out.stats), np.asarray(ref_out.stats))
+    np.testing.assert_array_equal(np.asarray(new_state.up_vals),
+                                  np.asarray(ref_state.up_vals))
+    assert not new_state.up_vals.sharding.is_fully_replicated
+    # rows are split across more than one host block: the addressable
+    # shard of device 0 must cover only B/(hosts*tenants) rows
+    shard_rows = new_state.up_vals.addressable_shards[0].data.shape[0]
+    tenants_dim = 8 // (hosts * slots_dim)
+    assert shard_rows == b // (hosts * tenants_dim)
 
 
 def test_graft_entry_contract():

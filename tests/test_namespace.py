@@ -43,7 +43,7 @@ def _has_finalizer(store, cluster, name) -> bool:
 
 def test_live_namespace_gains_finalizer():
     async def main():
-        store = LogicalStore()
+        store = LogicalStore(namespace_lifecycle=True)
         client = MultiClusterClient(store)
         ctrl = NamespaceLifecycleController(client)
         await ctrl.start()
@@ -58,7 +58,7 @@ def test_live_namespace_gains_finalizer():
 
 def test_deletion_sweeps_contents_then_removes_namespace():
     async def main():
-        store = LogicalStore()
+        store = LogicalStore(namespace_lifecycle=True)
         client = MultiClusterClient(store)
         ctrl = NamespaceLifecycleController(client)
         await ctrl.start()
@@ -86,7 +86,7 @@ def test_deletion_sweeps_contents_then_removes_namespace():
 
 def test_sweep_is_tenant_scoped():
     async def main():
-        store = LogicalStore()
+        store = LogicalStore(namespace_lifecycle=True)
         client = MultiClusterClient(store)
         ctrl = NamespaceLifecycleController(client)
         await ctrl.start()
@@ -118,7 +118,7 @@ def test_create_delete_race_cannot_orphan_contents():
     delete issued before the controller's first reconcile still sweeps."""
 
     async def main():
-        store = LogicalStore()
+        store = LogicalStore(namespace_lifecycle=True)
         client = MultiClusterClient(store)
         scoped = client.scoped("root")
         # namespace + contents + delete all BEFORE the controller starts
@@ -143,7 +143,7 @@ def test_create_delete_race_cannot_orphan_contents():
 
 def test_orphaned_contents_swept_after_out_of_band_finalizer_removal():
     async def main():
-        store = LogicalStore()
+        store = LogicalStore(namespace_lifecycle=True)
         client = MultiClusterClient(store)
         ctrl = NamespaceLifecycleController(client)
         await ctrl.start()
@@ -169,9 +169,21 @@ def test_orphaned_contents_swept_after_out_of_band_finalizer_removal():
     asyncio.run(main())
 
 
+def test_bare_store_does_not_stamp_finalizer():
+    """Physical-cluster fakes / controller-less stores must not hold
+    namespaces hostage: no stamping without namespace_lifecycle=True."""
+    store = LogicalStore()
+    client = MultiClusterClient(store)
+    client.scoped("phys").create("namespaces", {"metadata": {"name": "plain"}})
+    ns = store.get("namespaces", "phys", "plain")
+    assert FINALIZER not in (ns["metadata"].get("finalizers") or [])
+    client.scoped("phys").delete("namespaces", "plain")
+    assert _absent(store, "namespaces", "phys", "plain")  # deletes instantly
+
+
 def test_finalizered_content_defers_namespace_removal():
     async def main():
-        store = LogicalStore()
+        store = LogicalStore(namespace_lifecycle=True)
         client = MultiClusterClient(store)
         ctrl = NamespaceLifecycleController(client)
         await ctrl.start()
