@@ -54,6 +54,12 @@ def build_parser():
     start.add_argument("--poll-interval", type=float, default=60.0,
                        help="cluster health/API-import poll seconds "
                             "(reference: cluster.go:22, apiimporter.go:37)")
+    start.add_argument("--authz", action="store_true",
+                       help="enforce RBAC-lite (bearer tokens + per-tenant "
+                            "ClusterRole/Binding evaluation); admin token is "
+                            "minted into admin.kubeconfig")
+    start.add_argument("--admin-token", default="",
+                       help="fixed admin bearer token (minted when empty)")
     start.add_argument("-v", "--verbosity", type=int, default=0)
     return p
 
@@ -70,6 +76,8 @@ def config_from_args(args) -> Config:
         syncer_mode=args.syncer_mode,
         poll_interval=args.poll_interval,
         import_poll_interval=args.poll_interval,
+        authz=args.authz,
+        admin_token=args.admin_token,
     )
 
 

@@ -18,6 +18,7 @@ control plane at :6443) with the fork's logical-cluster semantics:
 from __future__ import annotations
 
 import json
+import os
 
 from ..apis.scheme import GVR, ResourceInfo, Scheme
 from ..store.selectors import parse_selector
@@ -49,9 +50,12 @@ class RestHandler:
     """Routes parsed HTTP requests onto a LogicalStore + Scheme."""
 
     def __init__(self, store: LogicalStore, scheme: Scheme,
-                 version_info: dict | None = None):
+                 version_info: dict | None = None,
+                 authenticator=None, authorizer=None):
         self.store = store
         self.scheme = scheme
+        self.authenticator = authenticator
+        self.authorizer = authorizer  # None = authz off (open prototype mode)
         self.version_info = version_info or {"major": "0", "minor": "1",
                                              "gitVersion": "kcp-tpu-v0.1.0"}
         # /readyz gate: flipped by Server once post-start hooks complete
@@ -139,6 +143,20 @@ class RestHandler:
             return _error_response(
                 errors.NotFoundError(f"the server could not find the requested "
                                      f"resource {resource} in {group}/{version}"))
+        if self.authorizer is not None:
+            from .authz import verb_for
+
+            user = self.authenticator.user_for(req.headers)
+            # ?watch=true is only served as a watch on collection GETs
+            # (named GETs fall through to a plain get below) — authorize
+            # the operation that will actually run
+            is_watch = name is None and req.param("watch") in ("true", "1")
+            verb = verb_for(req.method, name is not None, is_watch)
+            if not self.authorizer.allowed(user, cluster, verb, group, resource):
+                return Response.of_json(
+                    _status_body(403, "Forbidden",
+                                 f'user "{user}" cannot {verb} {resource} '
+                                 f'in logical cluster "{cluster}"'), 403)
         try:
             return await self._serve_resource(req, cluster, info, namespace, name, subresource)
         except errors.ApiError as e:
@@ -290,24 +308,30 @@ class RestHandler:
         return StreamResponse(produce)
 
 
-def render_kubeconfig(address: str, path: str) -> None:
+def render_kubeconfig(address: str, path: str, token: str = "") -> None:
     """Write an admin kubeconfig-style file with admin + user contexts.
 
     Mirrors the reference writing .kcp/admin.kubeconfig with contexts
     ``admin`` and ``user`` (the latter scoped to /clusters/user)
-    (reference: pkg/server/server.go:151-176).
+    (reference: pkg/server/server.go:151-176). When RBAC-lite is on,
+    the minted admin bearer token rides along as the user credential.
     """
+    users = [{"name": "admin", "user": ({"token": token} if token else {})}]
     cfg = {
         "kind": "Config", "apiVersion": "v1",
         "clusters": [
             {"name": "admin", "cluster": {"server": address}},
             {"name": "user", "cluster": {"server": f"{address}/clusters/user"}},
         ],
+        "users": users,
         "contexts": [
-            {"name": "admin", "context": {"cluster": "admin"}},
-            {"name": "user", "context": {"cluster": "user"}},
+            {"name": "admin", "context": {"cluster": "admin", "user": "admin"}},
+            {"name": "user", "context": {"cluster": "user", "user": "admin"}},
         ],
         "current-context": "admin",
     }
-    with open(path, "w", encoding="utf-8") as f:
+    # 0600: the file may carry a cluster-admin bearer token (kubeconfig
+    # convention)
+    fd = os.open(path, os.O_CREAT | os.O_WRONLY | os.O_TRUNC, 0o600)
+    with os.fdopen(fd, "w", encoding="utf-8") as f:
         json.dump(cfg, f, indent=2)

@@ -39,6 +39,9 @@ class Config:
     syncer_mode: str = "push"  # push | pull | none (controller.go:42-48)
     poll_interval: float = 15.0
     import_poll_interval: float = 15.0
+    authz: bool = False  # RBAC-lite enforcement (server/authz.py); the
+    # reference prototype runs open, so open stays the default
+    admin_token: str = ""  # minted when empty and authz is on
 
 
 class Server:
@@ -59,7 +62,19 @@ class Server:
             wal_path=wal,
             namespace_lifecycle=self.config.install_controllers,
         )
-        self.handler = RestHandler(self.store, self.scheme)
+        authn = authz = None
+        if self.config.authz:
+            import secrets as _secrets
+
+            from .authz import ADMIN_USER, Authenticator, Authorizer
+
+            if not self.config.admin_token:
+                self.config.admin_token = _secrets.token_urlsafe(24)
+            authn = Authenticator(tokens={self.config.admin_token: ADMIN_USER})
+            authz = Authorizer(self.store)
+        self.authenticator = authn
+        self.handler = RestHandler(self.store, self.scheme,
+                                   authenticator=authn, authorizer=authz)
         self.http = HttpServer(self.handler, self.config.listen_host,
                                self.config.listen_port)
         self.client = MultiClusterClient(self.store)
@@ -80,7 +95,8 @@ class Server:
         await self.http.start()
         if self.config.durable:
             render_kubeconfig(self.address,
-                              os.path.join(self.config.root_dir, "admin.kubeconfig"))
+                              os.path.join(self.config.root_dir, "admin.kubeconfig"),
+                              token=self.config.admin_token)
         if self.config.install_controllers:
             await self._install_controllers()
         for hook in self._post_start_hooks:
@@ -89,6 +105,11 @@ class Server:
         from ..utils.trace import REGISTRY
 
         REGISTRY.gauge("kcp_up", "1 once post-start hooks completed").set(1)
+        if self.config.authz and not self.config.durable:
+            # no kubeconfig to carry the minted token: surface it or every
+            # external client is locked out at 403
+            log.warning("RBAC-lite on without a kubeconfig; admin token: %s",
+                        self.config.admin_token)
         log.info("kcp-tpu serving at %s", self.address)
 
     async def _install_controllers(self) -> None:

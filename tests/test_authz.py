@@ -1,0 +1,142 @@
+"""RBAC-lite tests: token authn, per-tenant RBAC evaluation, handler
+enforcement, wildcard gating.
+
+The reference serves RBAC through its forked generic control plane
+(docs/investigations/minimal-api-server.md keeps RBAC in the minimal
+server); these tests pin the kcp-tpu equivalent (server/authz.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+from kcp_tpu.apis.scheme import default_scheme
+from kcp_tpu.server.authz import (
+    ANONYMOUS,
+    BINDINGS,
+    CLUSTERROLES,
+    Authenticator,
+    Authorizer,
+    verb_for,
+)
+from kcp_tpu.server.handler import RestHandler
+from kcp_tpu.server.httpd import Request
+from kcp_tpu.store import LogicalStore
+
+
+def _grant(store, cluster, user, role_name, rules=None):
+    if rules is not None:
+        store.create(CLUSTERROLES, cluster,
+                     {"metadata": {"name": role_name}, "rules": rules})
+    store.create(BINDINGS, cluster, {
+        "metadata": {"name": f"{user}-{role_name}"},
+        "subjects": [{"kind": "User", "name": user}],
+        "roleRef": {"name": role_name},
+    })
+
+
+class TestAuthenticator:
+    def test_bearer_token_resolution(self):
+        a = Authenticator(tokens={"tok-1": "alice"})
+        assert a.user_for({"authorization": "Bearer tok-1"}) == "alice"
+        assert a.user_for({"authorization": "bearer tok-1"}) == "alice"
+        assert a.user_for({"authorization": "Bearer nope"}) == ANONYMOUS
+        assert a.user_for({}) == ANONYMOUS
+
+
+class TestAuthorizer:
+    def test_rule_matching_and_wildcards(self):
+        store = LogicalStore()
+        authz = Authorizer(store)
+        _grant(store, "team-a", "alice", "cm-reader", rules=[
+            {"verbs": ["get", "list"], "apiGroups": [""], "resources": ["configmaps"]},
+        ])
+        assert authz.allowed("alice", "team-a", "get", "", "configmaps")
+        assert authz.allowed("alice", "team-a", "list", "", "configmaps")
+        assert not authz.allowed("alice", "team-a", "create", "", "configmaps")
+        assert not authz.allowed("alice", "team-a", "get", "", "secrets")
+        assert not authz.allowed("bob", "team-a", "get", "", "configmaps")
+
+        _grant(store, "team-a", "carol", "anything", rules=[
+            {"verbs": ["*"], "apiGroups": ["*"], "resources": ["*"]},
+        ])
+        assert authz.allowed("carol", "team-a", "delete", "apps", "deployments")
+
+    def test_rbac_is_tenant_scoped(self):
+        store = LogicalStore()
+        authz = Authorizer(store)
+        _grant(store, "team-a", "alice", "cluster-admin")
+        assert authz.allowed("alice", "team-a", "create", "", "secrets")
+        assert not authz.allowed("alice", "team-b", "get", "", "configmaps")
+
+    def test_wildcard_cluster_needs_root_admin(self):
+        store = LogicalStore()
+        authz = Authorizer(store)
+        _grant(store, "team-a", "alice", "cluster-admin")
+        assert not authz.allowed("alice", "*", "list", "", "configmaps")
+        _grant(store, "admin", "root-op", "cluster-admin")
+        assert authz.allowed("root-op", "*", "list", "", "configmaps")
+
+    def test_admin_user_is_always_allowed(self):
+        authz = Authorizer(LogicalStore())
+        assert authz.allowed("admin", "anywhere", "delete", "apps", "deployments")
+
+    def test_verb_mapping(self):
+        assert verb_for("GET", False, False) == "list"
+        assert verb_for("GET", True, False) == "get"
+        assert verb_for("GET", False, True) == "watch"
+        assert verb_for("POST", False, False) == "create"
+        assert verb_for("PUT", True, False) == "update"
+        assert verb_for("DELETE", True, False) == "delete"
+
+
+def _req(method, path, headers=None, body=b"", query=None):
+    return Request(method=method, path=path, query=query or {},
+                   headers=headers or {}, body=body)
+
+
+def test_handler_enforces_rbac():
+    async def main():
+        store = LogicalStore()
+        authn = Authenticator(tokens={"admin-tok": "admin", "alice-tok": "alice"})
+        handler = RestHandler(store, default_scheme(),
+                              authenticator=authn, authorizer=Authorizer(store))
+
+        # anonymous: forbidden
+        resp = await handler(_req("GET", "/clusters/team-a/api/v1/configmaps"))
+        assert resp.status == 403
+
+        # admin token: allowed
+        hdr = {"authorization": "Bearer admin-tok"}
+        resp = await handler(_req("GET", "/clusters/team-a/api/v1/configmaps", hdr))
+        assert resp.status == 200
+
+        # grant alice read-only on configmaps in team-a
+        _grant(store, "team-a", "alice", "cm-reader", rules=[
+            {"verbs": ["get", "list"], "apiGroups": [""], "resources": ["configmaps"]},
+        ])
+        hdr = {"authorization": "Bearer alice-tok"}
+        resp = await handler(_req("GET", "/clusters/team-a/api/v1/configmaps", hdr))
+        assert resp.status == 200
+        resp = await handler(_req(
+            "POST", "/clusters/team-a/api/v1/namespaces/default/configmaps", hdr,
+            body=json.dumps({"metadata": {"name": "x"}}).encode()))
+        assert resp.status == 403  # create not granted
+        resp = await handler(_req("GET", "/clusters/team-b/api/v1/configmaps", hdr))
+        assert resp.status == 403  # other tenant
+
+        # discovery and health stay open
+        resp = await handler(_req("GET", "/healthz"))
+        assert resp.status == 200
+
+    asyncio.run(main())
+
+
+def test_handler_open_without_authorizer():
+    async def main():
+        handler = RestHandler(LogicalStore(), default_scheme())
+        resp = await handler(_req("GET", "/clusters/team-a/api/v1/configmaps"))
+        assert resp.status == 200
+
+    asyncio.run(main())
