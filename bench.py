@@ -203,5 +203,101 @@ def main() -> int:
     return 0
 
 
+def _time_kernel(fn, *args, iters: int = 30) -> float:
+    """Median-of-three steady-state seconds per call (device inputs)."""
+    import jax
+
+    out = fn(*args)
+    jax.block_until_ready(out)
+    samples = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        samples.append((time.perf_counter() - t0) / iters)
+    return sorted(samples)[1]
+
+
+def suite() -> int:
+    """Benchmark the kernel lanes of BASELINE.json (configs[2..4]); print
+    a markdown table to stderr and one JSON object to stdout.
+
+    Not covered here: configs[0] (the demo scenario — run
+    ``contrib/demo/run_demo.py all --check``) and configs[1] (the
+    closed-loop syncer measurement — the default ``python bench.py``
+    run, whose single JSON line is the headline metric).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from kcp_tpu.ops.labelmatch import fanout_match
+    from kcp_tpu.ops.placement import split_replicas_jit
+    from kcp_tpu.ops.schemahash import schema_hashes_jit, tokenize_schema
+
+    rng = np.random.default_rng(3)
+    rows = []
+
+    # configs[2]: splitter bin-packing, 10k workspaces x 8 pclusters
+    replicas = jax.device_put(rng.integers(0, 100, 10_000).astype(np.int32))
+    avail = jax.device_put(rng.random((10_000, 8)) < 0.9)
+    dt = _time_kernel(split_replicas_jit, replicas, avail)
+    rows.append(("splitter bin-packing", "10k workspaces x 8 pclusters",
+                 f"{10_000 / dt / 1e6:.1f}M splits/s"))
+
+    # configs[3]: schema hashing for batch bucketing, 5k tenant CRD sets —
+    # host tokenization (per-schema) + one device hash reduce over the set
+    n_schemas = 5_000
+    schemas = [
+        {"type": "object", "properties": {
+            f"f{i}": {"type": "string"} for i in range(20)},
+         "description": str(k)}
+        for k in range(n_schemas)
+    ]
+    t0 = time.perf_counter()
+    tokens = np.stack([tokenize_schema(s) for s in schemas])
+    host_dt = time.perf_counter() - t0
+    toks = jax.device_put(tokens)
+    dev_dt = _time_kernel(schema_hashes_jit, toks)
+    dt = host_dt / n_schemas + dev_dt / n_schemas
+    rows.append(("schema hash bucketing", "5k tenant CRD sets",
+                 f"{1 / dt / 1e3:.0f}k schemas/s"))
+
+    # configs[4]: informer fan-out, 100k objects x 64 selectors
+    pair = jax.device_put(rng.integers(1, 1000, (100_000, 8)).astype(np.uint32))
+    sels = jax.device_put(rng.integers(1, 1000, 64).astype(np.uint32))
+    fan = jax.jit(lambda p, s: fanout_match(p, s).sum(axis=0, dtype=jnp.int32))
+    dt = _time_kernel(fan, pair, sels)
+    rows.append(("label fan-out", "100k objects x 64 selectors",
+                 f"{100_000 / dt / 1e6:.0f}M obj/s"))
+
+    print("| lane | scale | rate |", file=sys.stderr)
+    print("|---|---|---|", file=sys.stderr)
+    for name, scale, rate in rows:
+        print(f"| {name} | {scale} | {rate} |", file=sys.stderr)
+
+    print(json.dumps({"suite": [
+        {"lane": name, "scale": scale, "rate": rate} for name, scale, rate in rows
+    ]}))
+    return 0
+
+
 if __name__ == "__main__":
+    import os
+
+    # honor an explicit JAX_PLATFORMS override: the image's sitecustomize
+    # imports jax with the TPU platform baked in before shell env can
+    # land, so the config lever is the one that works (same workaround as
+    # __graft_entry__.dryrun_multichip)
+    want = os.environ.get("JAX_PLATFORMS", "")
+    if want and want != "axon":
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", want)
+        except Exception as e:
+            print(f"warning: could not force JAX platform {want!r} ({e}); "
+                  f"continuing on the baked-in platform", file=sys.stderr)
+    if "--suite" in sys.argv:
+        sys.exit(suite())
     sys.exit(main())
