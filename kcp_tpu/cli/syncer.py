@@ -4,17 +4,26 @@ The analog of the reference's cmd/syncer/main.go:24-73: connect upstream
 (kcp, filtered to one logical cluster) and downstream (physical cluster),
 then run the batched spec-downsync + status-upsync engine for the listed
 resource types. In the reference this binary is what pull-mode deploys
-into each physical cluster.
+into each physical cluster; the installed Deployment invokes the pod
+form (``-from_kubeconfig /kcp/kubeconfig -cluster <name> <resources>``,
+reference flags cmd/syncer/main.go:17-28), which this binary accepts
+natively — the pull-mode emulator (kcp_tpu/physical/podrunner.py) parses
+installed args through THIS parser so installer, binary, and emulator
+share one argument surface.
 
-Usage:
+Usage (direct):
     python -m kcp_tpu.cli.syncer --from-server http://kcp:6443 \
         --from-cluster tenant-a --to-server http://physical:8080 \
         --cluster us-east1 deployments.apps configmaps
+Usage (pod form):
+    python -m kcp_tpu.cli.syncer -from_kubeconfig /kcp/kubeconfig \
+        --to-server http://physical:8080 -cluster us-east1 configmaps
 """
 
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
 import signal
 import sys
@@ -28,19 +37,29 @@ selected by the kcp.dev/cluster=<cluster> label; sync decisions are
 computed by the batched TPU diff kernel."""
 
 
-def build_parser():
+def build_parser(pod_form_only: bool = False):
+    """The one syncer argument surface.
+
+    ``pod_form_only`` relaxes the server flags for parsing an installed
+    Deployment's args (the pod gets its downstream in-cluster, so the
+    manifest carries no --to-server).
+    """
     p = parser("syncer", DOC)
-    p.add_argument("--from-server", required=True,
-                   help="upstream kcp-tpu URL (reference: -from_kubeconfig)")
+    p.add_argument("--from-server", default=None,
+                   help="upstream kcp-tpu URL")
+    p.add_argument("-from_kubeconfig", "--from-kubeconfig",
+                   dest="from_kubeconfig", default=None,
+                   help="path to an upstream kubeconfig (the pull-mode pod "
+                        "mount; reference: -from_kubeconfig)")
     p.add_argument("--from-cluster", default="admin",
                    help="upstream logical cluster name")
-    p.add_argument("--to-server", required=True,
+    p.add_argument("--to-server", required=not pod_form_only, default=None,
                    help="downstream physical cluster URL (reference: "
                         "-to_kubeconfig / in-cluster config)")
     p.add_argument("--to-cluster", default="default",
                    help="downstream tenant (physical servers are usually "
                         "single-tenant: 'default')")
-    p.add_argument("--cluster", required=True,
+    p.add_argument("-cluster", "--cluster", dest="cluster", required=True,
                    help="sync target id — the kcp.dev/cluster label value "
                         "(reference: -cluster)")
     p.add_argument("--backend", choices=["tpu", "host"], default="tpu")
@@ -49,10 +68,30 @@ def build_parser():
     return p
 
 
+def kubeconfig_server_url(content: str) -> str:
+    """Server URL of the current-context cluster in a kubeconfig
+    (the JSON shape render_kubeconfig writes)."""
+    cfg = json.loads(content)
+    current = cfg.get("current-context", "")
+    ctx = next((c["context"] for c in cfg.get("contexts", [])
+                if c.get("name") == current), None)
+    cluster_name = (ctx or {}).get("cluster") or current
+    for c in cfg.get("clusters", []):
+        if c.get("name") == cluster_name:
+            return c["cluster"]["server"]
+    raise ValueError(f"kubeconfig has no cluster {cluster_name!r}")
+
+
 async def run(args) -> None:
     from ..syncer import start_syncer
 
-    upstream = RestClient(args.from_server, cluster=args.from_cluster)
+    from_server = args.from_server
+    if from_server is None:
+        if not args.from_kubeconfig:
+            raise SystemExit("one of --from-server / -from_kubeconfig required")
+        with open(args.from_kubeconfig, encoding="utf-8") as f:
+            from_server = kubeconfig_server_url(f.read())
+    upstream = RestClient(from_server, cluster=args.from_cluster)
     downstream = RestClient(args.to_server, cluster=args.to_cluster)
     syncer = await start_syncer(upstream, downstream, args.resources,
                                 args.cluster, backend=args.backend)

@@ -1,0 +1,94 @@
+"""Pull-mode end to end: installed manifests boot a working syncer.
+
+The reference's pull mode deploys the standalone syncer binary as a Pod
+(pkg/reconciler/cluster/syncer.go:38-227) which then syncs exactly like
+push mode. These tests run that pod's job in-process from the INSTALLED
+manifests (kcp_tpu/physical/podrunner.py), so installer output and
+syncer-binary expectations cannot drift apart silently.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from kcp_tpu.client import Client
+from kcp_tpu.physical.podrunner import (
+    PodSpecError,
+    parse_installed_syncer,
+    run_installed_syncer,
+)
+from kcp_tpu.reconcilers.cluster import installer
+from kcp_tpu.store import LogicalStore
+from kcp_tpu.utils.errors import NotFoundError
+import pytest
+
+
+async def _settle(predicate, timeout=3.0, interval=0.02):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        if predicate():
+            return True
+        await asyncio.sleep(interval)
+    return predicate()
+
+
+def test_parse_installed_syncer_roundtrip():
+    phys = Client(LogicalStore(), "pcluster")
+    installer.install_syncer(phys, "east", "kcp://test-kubeconfig",
+                             ["configmaps", "deployments.apps"])
+    kubeconfig, cluster, resources = parse_installed_syncer(phys)
+    assert kubeconfig == "kcp://test-kubeconfig"
+    assert cluster == "east"
+    assert resources == ["configmaps", "deployments.apps"]
+
+
+def test_parse_uninstalled_raises():
+    phys = Client(LogicalStore(), "pcluster")
+    with pytest.raises(PodSpecError, match="not installed"):
+        parse_installed_syncer(phys)
+
+
+def test_installed_syncer_actually_syncs():
+    async def main():
+        kcp = LogicalStore()
+        up = Client(kcp, "tenant")
+        phys = Client(LogicalStore(), "pcluster")
+
+        installer.install_syncer(phys, "east", "kcp://tenant", ["configmaps"])
+        syncer = await run_installed_syncer(
+            phys, resolve_kubeconfig=lambda kc: up, backend="host")
+        try:
+            up.create("configmaps", {
+                "apiVersion": "v1", "kind": "ConfigMap",
+                "metadata": {"name": "pulled", "namespace": "default",
+                             "labels": {"kcp.dev/cluster": "east"}},
+                "data": {"k": "v"}})
+            ok = await _settle(lambda: _exists(phys, "configmaps", "pulled", "default"))
+            assert ok, "labeled object should downsync via the installed syncer"
+            # status upsync through the same pod
+            obj = phys.get("configmaps", "pulled", "default")
+            obj["status"] = {"phase": "Bound"}
+            phys.update_status("configmaps", obj)
+            ok = await _settle(lambda: (up.get("configmaps", "pulled", "default")
+                                        .get("status") == {"phase": "Bound"}))
+            assert ok
+        finally:
+            await syncer.stop()
+
+    asyncio.run(main())
+
+
+def test_uninstall_then_run_fails():
+    phys = Client(LogicalStore(), "pcluster")
+    installer.install_syncer(phys, "east", "kcp://tenant", ["configmaps"])
+    installer.uninstall_syncer(phys)
+    with pytest.raises(PodSpecError):
+        parse_installed_syncer(phys)
+
+
+def _exists(client, gvr, name, ns) -> bool:
+    try:
+        client.get(gvr, name, ns)
+        return True
+    except NotFoundError:
+        return False
