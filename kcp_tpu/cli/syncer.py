@@ -68,30 +68,34 @@ def build_parser(pod_form_only: bool = False):
     return p
 
 
-def kubeconfig_server_url(content: str) -> str:
-    """Server URL of the current-context cluster in a kubeconfig
-    (the JSON shape render_kubeconfig writes)."""
+def kubeconfig_credentials(content: str) -> tuple[str, str]:
+    """(server URL, bearer token) of the current context in a kubeconfig
+    (the JSON shape render_kubeconfig writes; token empty when the
+    server runs open)."""
     cfg = json.loads(content)
     current = cfg.get("current-context", "")
     ctx = next((c["context"] for c in cfg.get("contexts", [])
-                if c.get("name") == current), None)
-    cluster_name = (ctx or {}).get("cluster") or current
+                if c.get("name") == current), None) or {}
+    cluster_name = ctx.get("cluster") or current
+    user_name = ctx.get("user", "")
+    token = next((u.get("user", {}).get("token", "")
+                  for u in cfg.get("users", []) if u.get("name") == user_name), "")
     for c in cfg.get("clusters", []):
         if c.get("name") == cluster_name:
-            return c["cluster"]["server"]
+            return c["cluster"]["server"], token
     raise ValueError(f"kubeconfig has no cluster {cluster_name!r}")
 
 
 async def run(args) -> None:
     from ..syncer import start_syncer
 
-    from_server = args.from_server
+    from_server, token = args.from_server, ""
     if from_server is None:
         if not args.from_kubeconfig:
             raise SystemExit("one of --from-server / -from_kubeconfig required")
         with open(args.from_kubeconfig, encoding="utf-8") as f:
-            from_server = kubeconfig_server_url(f.read())
-    upstream = RestClient(from_server, cluster=args.from_cluster)
+            from_server, token = kubeconfig_credentials(f.read())
+    upstream = RestClient(from_server, cluster=args.from_cluster, token=token)
     downstream = RestClient(args.to_server, cluster=args.to_cluster)
     syncer = await start_syncer(upstream, downstream, args.resources,
                                 args.cluster, backend=args.backend)

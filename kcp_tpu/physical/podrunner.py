@@ -21,7 +21,7 @@ from __future__ import annotations
 from typing import Callable
 
 from ..client import Client
-from ..syncer.syncer import Syncer
+from ..syncer.syncer import Syncer, start_syncer
 from ..utils import errors
 from ..reconcilers.cluster.installer import SYNCER_NAME, SYNCER_NAMESPACE
 
@@ -79,6 +79,8 @@ async def run_installed_syncer(
     """
     kubeconfig, cluster, resources = parse_installed_syncer(physical)
     upstream = resolve_kubeconfig(kubeconfig)
-    syncer = Syncer(upstream, physical, resources, cluster, backend=backend)
-    await syncer.start()
-    return syncer
+    # start_syncer, not Syncer: the pod's binary validates the resource
+    # set via discovery first (RetryableError while a resource is not
+    # served yet), and the emulator must fail the same way
+    return await start_syncer(upstream, physical, resources, cluster,
+                              backend=backend)

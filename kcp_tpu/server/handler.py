@@ -204,11 +204,16 @@ class RestHandler:
                 "the status subresource supports get and update only")
 
         if req.method == "GET":
+            from ..apis.printers import render_table, wants_table
+
+            as_table = wants_table(req.headers.get("accept", ""))
             if name is None:
                 if req.param("watch") in ("true", "1"):
                     return self._watch(req, cluster, res, namespace or None)
                 selector = parse_selector(req.param("labelSelector"))
                 items, rv = self.store.list(res, cluster, namespace or None, selector)
+                if as_table:  # kubectl get: server-side printer columns
+                    return Response.of_json(render_table(res, items, rv))
                 return Response.of_json({
                     "kind": info.list_kind, "apiVersion": gv,
                     "metadata": {"resourceVersion": str(rv)},
@@ -216,6 +221,11 @@ class RestHandler:
                 })
             obj = self.store.get(res, self._read_cluster(cluster, res, name, namespace),
                                  name, namespace)
+            # no table transform for the status subresource (matches the
+            # real apiserver: table rendering applies to objects, not
+            # subresources)
+            if as_table and subresource is None:
+                return Response.of_json(render_table(res, [obj]))
             return Response.of_json(self._stamp(obj, info, gv))
 
         if req.method == "POST" and name is None:

@@ -58,10 +58,12 @@ class RestWatch:
     :meth:`drain`, :meth:`close`.
     """
 
-    def __init__(self, host: str, port: int, path: str, resource: str):
+    def __init__(self, host: str, port: int, path: str, resource: str,
+                 token: str = ""):
         self._host = host
         self._port = port
         self._path = path
+        self._token = token
         self.resource = resource
         self._events: asyncio.Queue[Event | None] = asyncio.Queue()
         self._task: asyncio.Task | None = None
@@ -76,9 +78,11 @@ class RestWatch:
         reader = writer = None
         try:
             reader, writer = await asyncio.open_connection(self._host, self._port)
+            auth = (f"Authorization: Bearer {self._token}\r\n"
+                    if self._token else "")
             writer.write(
                 f"GET {self._path} HTTP/1.1\r\nHost: {self._host}\r\n"
-                "Connection: close\r\n\r\n".encode())
+                f"{auth}Connection: close\r\n\r\n".encode())
             await writer.drain()
             head = await reader.readuntil(b"\r\n\r\n")
             status_line = head.split(b"\r\n", 1)[0].decode("latin-1")
@@ -203,18 +207,19 @@ class RestClient:
     """HTTP twin of :class:`kcp_tpu.client.Client`."""
 
     def __init__(self, base_url: str, cluster: str = "admin",
-                 scheme: Scheme | None = None):
+                 scheme: Scheme | None = None, token: str = ""):
         parts = urlsplit(base_url)
         self._host = parts.hostname or "127.0.0.1"
         self._port = parts.port or 80
         self.base_url = base_url.rstrip("/")
         self.cluster = cluster
         self.scheme = scheme if scheme is not None else default_scheme()
+        self.token = token  # bearer credential (RBAC-lite servers)
         self._discovered: dict[str, ResourceInfo] = {}
         self._conn: http.client.HTTPConnection | None = None
 
     def scoped(self, cluster: str) -> "RestClient":
-        c = RestClient(self.base_url, cluster, self.scheme)
+        c = RestClient(self.base_url, cluster, self.scheme, token=self.token)
         c._discovered = self._discovered
         return c
 
@@ -232,6 +237,8 @@ class RestClient:
         """
         payload = json.dumps(body).encode() if body is not None else None
         headers = {"Content-Type": "application/json"} if payload else {}
+        if self.token:
+            headers["Authorization"] = f"Bearer {self.token}"
         for attempt in (0, 1):
             reused = self._conn is not None
             if self._conn is None:
@@ -344,7 +351,7 @@ class RestClient:
         if since_rv is not None:
             query += f"&resourceVersion={since_rv}"
         path = self._path(res, namespace, query=query)
-        return RestWatch(self._host, self._port, path, res)
+        return RestWatch(self._host, self._port, path, res, token=self.token)
 
     # ------------------------------------------------------------- writes
 

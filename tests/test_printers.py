@@ -1,0 +1,109 @@
+"""Server-side Table rendering tests (the kubectl get -o wide surface).
+
+Reference behavior: kubebuilder printcolumn annotations on the CRD types
+(apiresourceimport_types.go:32-37) rendered by the apiserver when Accept
+asks for the meta.k8s.io Table encoding.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from kcp_tpu.apis.printers import render_table, wants_table
+from kcp_tpu.apis.scheme import default_scheme
+from kcp_tpu.server.handler import RestHandler
+from kcp_tpu.server.httpd import Request
+from kcp_tpu.store import LogicalStore
+
+TABLE_ACCEPT = "application/json;as=Table;v=v1;g=meta.k8s.io"
+
+
+class TestWantsTable:
+    def test_accept_parsing(self):
+        assert wants_table(TABLE_ACCEPT)
+        assert wants_table("application/json ; as=Table ; v=v1")
+        assert not wants_table("application/json")
+        assert not wants_table("")
+
+
+class TestRenderTable:
+    def test_apiresourceimport_columns(self):
+        obj = {
+            "metadata": {"name": "east.deployments.v1.apps",
+                         "creationTimestamp": "2026-07-29T00:00:00Z"},
+            "spec": {"location": "east", "schemaUpdateStrategy": "UpdateUnpublished",
+                     "groupVersion": "apps/v1", "plural": "deployments"},
+            "status": {"conditions": [
+                {"type": "Compatible", "status": "True"},
+                {"type": "Available", "status": "False"},
+            ]},
+        }
+        t = render_table("apiresourceimports.apiresource.kcp.dev", [obj], 7)
+        names = [c["name"] for c in t["columnDefinitions"]]
+        assert names == ["Name", "Location", "Schema update strategy",
+                         "API Version", "API Resource", "Compatible",
+                         "Available", "Age"]
+        cells = t["rows"][0]["cells"]
+        assert cells[:7] == ["east.deployments.v1.apps", "east",
+                             "UpdateUnpublished", "apps/v1", "deployments",
+                             "True", "False"]
+        assert t["metadata"]["resourceVersion"] == "7"
+
+    def test_cluster_columns(self):
+        obj = {"metadata": {"name": "us-east1"},
+               "status": {"conditions": [{"type": "Ready", "status": "True"}],
+                          "syncedResources": ["deployments.apps", "configmaps"]}}
+        t = render_table("clusters.cluster.example.dev", [obj])
+        cells = t["rows"][0]["cells"]
+        assert cells[2] == "True"
+        assert cells[3] == "deployments.apps,configmaps"
+
+    def test_deployment_ready_fraction(self):
+        obj = {"metadata": {"name": "web"},
+               "spec": {"replicas": 5}, "status": {"readyReplicas": 3}}
+        t = render_table("deployments.apps", [obj])
+        assert t["rows"][0]["cells"][1] == "3/5"
+
+    def test_namespace_terminating(self):
+        live = {"metadata": {"name": "a"}}
+        term = {"metadata": {"name": "b", "deletionTimestamp": "t"}}
+        t = render_table("namespaces", [live, term])
+        assert [r["cells"][1] for r in t["rows"]] == ["Active", "Terminating"]
+
+    def test_generic_fallback(self):
+        t = render_table("secrets", [{"metadata": {"name": "s"}}])
+        assert [c["name"] for c in t["columnDefinitions"]] == ["Name", "Age"]
+
+
+def test_handler_serves_table_on_accept():
+    async def main():
+        store = LogicalStore()
+        store.create("configmaps", "root", {"metadata": {"name": "cm"},
+                                            "data": {"a": "1", "b": "2"}}, "ns")
+        handler = RestHandler(store, default_scheme())
+
+        # list as table
+        resp = await handler(Request(
+            method="GET", path="/clusters/root/api/v1/configmaps", query={},
+            headers={"accept": TABLE_ACCEPT}, body=b""))
+        import json
+
+        table = json.loads(resp.body)
+        assert table["kind"] == "Table"
+        assert table["rows"][0]["cells"][1] == "2"  # Data count
+
+        # named get as table
+        resp = await handler(Request(
+            method="GET",
+            path="/clusters/root/api/v1/namespaces/ns/configmaps/cm", query={},
+            headers={"accept": TABLE_ACCEPT}, body=b""))
+        table = json.loads(resp.body)
+        assert table["kind"] == "Table" and len(table["rows"]) == 1
+
+        # plain JSON unchanged without the Accept
+        resp = await handler(Request(
+            method="GET", path="/clusters/root/api/v1/configmaps", query={},
+            headers={}, body=b""))
+        assert json.loads(resp.body)["kind"] == "ConfigMapList"
+
+    asyncio.run(main())
