@@ -61,6 +61,14 @@ def build_parser():
     start.add_argument("--admin-token", default="",
                        help="fixed admin bearer token (minted when empty)")
     start.add_argument("-v", "--verbosity", type=int, default=0)
+
+    snap = sub.add_parser(
+        "snapshot",
+        help="compact the WAL offline (etcdctl-snapshot analog)",
+        description="Load the store from its WAL, write a snapshot and "
+                    "truncate the log. Run only while the server is down.")
+    snap.add_argument("--root-dir", default=".kcp_tpu")
+    snap.add_argument("-v", "--verbosity", type=int, default=0)
     return p
 
 
@@ -99,11 +107,31 @@ async def serve(config: Config) -> None:
     await server.run()
 
 
+def snapshot_cmd(args) -> int:
+    """Offline WAL compaction: replay, snapshot, truncate, report."""
+    import os
+
+    from ..store import LogicalStore
+
+    wal = os.path.join(args.root_dir, "store.wal")
+    if not os.path.exists(wal) and not os.path.exists(wal + ".snap"):
+        print(f"no WAL at {wal}", file=sys.stderr)
+        return 1
+    store = LogicalStore(wal_path=wal)
+    objects, rv = len(store), store.resource_version
+    store.snapshot()
+    store.close()
+    print(f"compacted {wal}: {objects} objects at rv {rv}")
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     logging.basicConfig(
         level=logging.DEBUG if args.verbosity > 0 else logging.INFO,
         format="%(asctime)s %(levelname).1s %(name)s: %(message)s")
+    if args.command == "snapshot":
+        return snapshot_cmd(args)
     asyncio.run(serve(config_from_args(args)))
     return 0
 

@@ -299,8 +299,28 @@ class RestHandler:
             since_rv = int(since) if since else None
         except ValueError as e:
             raise errors.BadRequestError(f"malformed resourceVersion {since!r}") from e
+        timeout_s = req.param("timeoutSeconds")
+        try:
+            timeout = float(timeout_s) if timeout_s else None
+        except ValueError as e:
+            raise errors.BadRequestError(
+                f"malformed timeoutSeconds {timeout_s!r}") from e
+        import math
+
+        if timeout is not None and (not math.isfinite(timeout) or timeout < 0):
+            # nan/inf would turn the deadline math into a busy-spin
+            raise errors.BadRequestError(
+                f"timeoutSeconds must be a finite non-negative number, "
+                f"got {timeout_s!r}")
+        bookmarks = req.param("allowWatchBookmarks") in ("true", "1")
+        # bookmark cadence: frequent enough that resuming clients lose
+        # little window, cheap enough to be noise (apiserver uses ~1/min;
+        # our watch windows are smaller)
+        bookmark_every = 5.0
 
         async def produce(stream: StreamResponse) -> None:
+            import asyncio
+
             try:
                 watch = self.store.watch(res, cluster, namespace, selector, since_rv)
             except errors.ConflictError as e:
@@ -309,8 +329,34 @@ class RestHandler:
                 await stream.send_json({"type": "ERROR",
                                         "object": _status_body(410, "Expired", e.message)})
                 return
+            loop = asyncio.get_event_loop()
+            deadline = loop.time() + timeout if timeout else None
             try:
-                async for ev in watch:
+                it = watch.__aiter__()
+                while True:
+                    step = bookmark_every if bookmarks else 3600.0
+                    if deadline is not None:
+                        step = min(step, max(0.0, deadline - loop.time()))
+                    try:
+                        ev = await asyncio.wait_for(it.__anext__(), timeout=step)
+                    except asyncio.TimeoutError:
+                        if deadline is not None and loop.time() >= deadline:
+                            return  # server-side watch timeout: clean close
+                        # only bookmark when nothing is buffered: the store
+                        # RV may already cover an event still queued in this
+                        # watch, and a client resuming from such a bookmark
+                        # would skip that event forever
+                        if bookmarks and not watch.pending():
+                            # progress marker carrying the current RV so
+                            # clients can resume without replay
+                            await stream.send_json({
+                                "type": "BOOKMARK",
+                                "object": {"kind": "Bookmark", "metadata": {
+                                    "resourceVersion": str(self.store.resource_version)}},
+                            })
+                        continue
+                    except StopAsyncIteration:
+                        return
                     await stream.send_json({"type": ev.type, "object": ev.object})
             finally:
                 watch.close()

@@ -69,6 +69,7 @@ class RestWatch:
         self._task: asyncio.Task | None = None
         self._closed = False
         self.error: Exception | None = None  # set on non-2xx watch responses
+        self.last_rv = 0  # highest RV seen (events + bookmarks), for resume
 
     def _ensure_started(self) -> None:
         if self._task is None and not self._closed:
@@ -130,8 +131,18 @@ class RestWatch:
             self._closed = True
             self._events.put_nowait(None)
             return
+        if msg.get("type") == "BOOKMARK":
+            # progress marker: remember the RV for resume, emit nothing
+            meta = (msg.get("object") or {}).get("metadata") or {}
+            try:
+                self.last_rv = int(meta.get("resourceVersion", "0"))
+            except ValueError:
+                pass
+            return
         obj = msg["object"]
         meta = obj.get("metadata") or {}
+        rv = int(meta.get("resourceVersion", "0"))
+        self.last_rv = max(self.last_rv, rv)
         self._events.put_nowait(Event(
             type=msg["type"],
             resource=self.resource,
@@ -139,7 +150,7 @@ class RestWatch:
             namespace=meta.get("namespace", ""),
             name=meta.get("name", ""),
             object=obj,
-            rv=int(meta.get("resourceVersion", "0")),
+            rv=rv,
         ))
 
     def __aiter__(self) -> "RestWatch":
