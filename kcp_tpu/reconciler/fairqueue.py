@@ -1,0 +1,212 @@
+"""FairWorkQueue: the native per-tenant-fair queue behind the WorkQueue
+interface.
+
+Cross-tenant controllers (negotiation, cluster lifecycle, namespace
+sweep) share one queue across every logical cluster; with plain FIFO a
+tenant flooding events starves the rest. The native scheduler
+(native/workqueue.cc) keeps the client-go contract — dedup while
+pending, per-item exponential backoff, redo-after-done — and drains
+round-robin across tenants, so each batch carries at most one item per
+tenant per pass.
+
+Drop-in for :class:`kcp_tpu.reconciler.queue.WorkQueue` (same methods,
+same Controller/BatchController compatibility). ``tenant_of`` maps an
+item to its tenant; the default treats tuple items' first element as
+the tenant (the (cluster, name) key shape every controller here uses).
+When the native library is unavailable, :func:`make_queue` falls back
+to the plain WorkQueue — correctness intact, fairness best-effort.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import ctypes
+import time
+from typing import Callable, Hashable
+
+from .queue import WorkQueue
+
+Item = Hashable
+
+
+def _default_tenant(item: Item) -> str:
+    if isinstance(item, tuple) and item:
+        return str(item[0])
+    return ""
+
+
+class FairWorkQueue:
+    """WorkQueue-compatible wrapper over the native fair scheduler."""
+
+    def __init__(self, name: str = "fairqueue",
+                 tenant_of: Callable[[Item], str] = _default_tenant):
+        from ..native import load
+
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._declare(lib)
+        self._q = lib.wq_new()
+        self.name = name
+        self.tenant_of = tenant_of
+        self._ids: dict[Item, int] = {}
+        self._items: dict[int, Item] = {}
+        self._next_id = 1
+        self._tenants: dict[str, int] = {}
+        self._wakeup = asyncio.Event()
+        self._shutdown = False
+
+    @staticmethod
+    def _declare(lib) -> None:
+        if getattr(lib, "_wq_declared", False):
+            return
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        lib.wq_new.restype = ctypes.c_void_p
+        lib.wq_free.argtypes = [ctypes.c_void_p]
+        lib.wq_add.argtypes = [ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint32]
+        lib.wq_add_after.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                     ctypes.c_uint32, ctypes.c_double, ctypes.c_double]
+        lib.wq_add_rate_limited.restype = ctypes.c_uint32
+        lib.wq_add_rate_limited.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                            ctypes.c_uint32, ctypes.c_double]
+        lib.wq_num_requeues.restype = ctypes.c_uint32
+        lib.wq_num_requeues.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.wq_forget.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.wq_promote.restype = ctypes.c_double
+        lib.wq_promote.argtypes = [ctypes.c_void_p, ctypes.c_double]
+        lib.wq_drain.restype = ctypes.c_uint32
+        lib.wq_drain.argtypes = [ctypes.c_void_p, ctypes.c_double, u64p, ctypes.c_uint32]
+        lib.wq_done.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.wq_len.restype = ctypes.c_uint64
+        lib.wq_len.argtypes = [ctypes.c_void_p]
+        lib._wq_declared = True
+
+    # ---------------------------------------------------------- id mapping
+
+    def _id(self, item: Item) -> int:
+        i = self._ids.get(item)
+        if i is None:
+            i = self._next_id
+            self._next_id += 1
+            self._ids[item] = i
+            self._items[i] = item
+        return i
+
+    def _tenant(self, item: Item) -> int:
+        t = self.tenant_of(item)
+        tid = self._tenants.get(t)
+        if tid is None:
+            tid = len(self._tenants)
+            self._tenants[t] = tid
+        return tid
+
+    # -------------------------------------------------------------- adding
+
+    def add(self, item: Item) -> None:
+        if self._shutdown:
+            return
+        self._lib.wq_add(self._q, self._id(item), self._tenant(item))
+        self._wakeup.set()
+
+    def add_after(self, item: Item, delay: float) -> None:
+        if self._shutdown:
+            return
+        self._lib.wq_add_after(self._q, self._id(item), self._tenant(item),
+                               time.monotonic(), delay)
+        self._wakeup.set()
+
+    def add_rate_limited(self, item: Item) -> None:
+        if self._shutdown:
+            return
+        self._lib.wq_add_rate_limited(self._q, self._id(item),
+                                      self._tenant(item), time.monotonic())
+        self._wakeup.set()
+
+    def num_requeues(self, item: Item) -> int:
+        i = self._ids.get(item)
+        return self._lib.wq_num_requeues(self._q, i) if i is not None else 0
+
+    def forget(self, item: Item) -> None:
+        i = self._ids.get(item)
+        if i is not None:
+            self._lib.wq_forget(self._q, i)
+
+    # ------------------------------------------------------------ consuming
+
+    def _pop_ready(self, max_items: int) -> list[Item]:
+        buf = (ctypes.c_uint64 * max_items)()
+        n = self._lib.wq_drain(self._q, time.monotonic(), buf, max_items)
+        return [self._items[buf[i]] for i in range(n)]
+
+    async def get(self) -> Item | None:
+        while True:
+            got = self._pop_ready(1)
+            if got:
+                return got[0]
+            if self._shutdown:
+                return None
+            next_due = self._lib.wq_promote(self._q, time.monotonic())
+            self._wakeup.clear()
+            try:
+                await asyncio.wait_for(
+                    self._wakeup.wait(),
+                    timeout=next_due if next_due >= 0 else None)
+            except asyncio.TimeoutError:
+                pass
+
+    async def drain(self, max_items: int = 1024, max_wait: float = 0.005) -> list[Item]:
+        first = await self.get()
+        if first is None:
+            return []
+        batch = [first]
+        deadline = time.monotonic() + max_wait
+        while len(batch) < max_items:
+            more = self._pop_ready(max_items - len(batch))
+            if more:
+                batch.extend(more)
+                continue
+            remaining = deadline - time.monotonic()
+            if remaining <= 0 or self._shutdown:
+                break
+            self._wakeup.clear()
+            try:
+                await asyncio.wait_for(self._wakeup.wait(), timeout=remaining)
+            except asyncio.TimeoutError:
+                break
+        return batch
+
+    def done(self, item: Item) -> None:
+        i = self._ids.get(item)
+        if i is not None:
+            self._lib.wq_done(self._q, i)
+
+    # ------------------------------------------------------------- control
+
+    def shut_down(self) -> None:
+        self._shutdown = True
+        self._wakeup.set()
+
+    def __len__(self) -> int:
+        return self._lib.wq_len(self._q)
+
+    @property
+    def shutting_down(self) -> bool:
+        return self._shutdown
+
+    def __del__(self):
+        try:
+            if getattr(self, "_q", None):
+                self._lib.wq_free(self._q)
+                self._q = None
+        except Exception:
+            pass
+
+
+def make_queue(name: str = "queue",
+               tenant_of: Callable[[Item], str] = _default_tenant):
+    """FairWorkQueue when the native library loads, else WorkQueue."""
+    try:
+        return FairWorkQueue(name, tenant_of)
+    except Exception:
+        return WorkQueue(name)

@@ -85,6 +85,28 @@ int enc_bucket_path(void* b, uint32_t slot, const char** path, uint32_t* plen);
 // Seed the vocabulary (e.g. restoring a bucket). Returns slot or -1.
 int enc_bucket_add_path(void* b, const char* path, uint32_t plen);
 
+// ---------------------------------------------------------- fair workqueue
+
+// Round-robin-fair, rate-limited work queue (workqueue.cc). Items are
+// opaque uint64 ids grouped by uint32 tenant; time is caller-supplied
+// monotonic seconds. Contract mirrors kcp_tpu/reconciler/queue.py
+// (client-go semantics) plus per-tenant fairness on drain.
+void* wq_new(void);
+void wq_free(void* q);
+void wq_add(void* q, uint64_t id, uint32_t tenant);
+void wq_add_after(void* q, uint64_t id, uint32_t tenant, double now, double delay);
+// Returns the new retry count.
+uint32_t wq_add_rate_limited(void* q, uint64_t id, uint32_t tenant, double now);
+uint32_t wq_num_requeues(void* q, uint64_t id);
+void wq_forget(void* q, uint64_t id);
+// Promote due delayed items; returns seconds to next due item (-1 none).
+double wq_promote(void* q, double now);
+// Fill out[0..max) with ready ids, one per tenant per round-robin pass;
+// returns the count. Items must be wq_done()d.
+uint32_t wq_drain(void* q, double now, uint64_t* out, uint32_t max_items);
+void wq_done(void* q, uint64_t id);
+uint64_t wq_len(void* q);
+
 // Hash one JSON value canonically (twin of hashing.hash_value).
 // Returns 0 only on parse error (real hashes are never 0).
 uint32_t enc_hash_value(const char* json, size_t len);
