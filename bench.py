@@ -1,39 +1,40 @@
 #!/usr/bin/env python
 """North-star benchmark: reconciles/sec across 10k logical clusters.
 
-Measures the fused reconcile step (kcp_tpu/models/reconcile_model.py) at
-BASELINE.json scale on the available accelerator: 10k logical clusters x
-13 objects = 131,072 resident object rows, 64 slots, plus the splitter
-lane (10k roots x 8 clusters) and the informer fan-out lane (rows x 64
-selectors) — every lane of the control plane in one device program.
+Drives the SERVING engine, not an emulation: a
+:class:`kcp_tpu.syncer.core.FusedCore` — the same BatchController tick
+loop, packed-wire fused ``reconcile_step``, pipelined collection, and
+patch dispatch that ``BatchSyncEngine`` serves through — with a synthetic
+section owner standing in for the informer caches and the store applier.
+At BASELINE.json scale: 10k logical clusters x 13 objects = 131,072
+resident rows, 64 slots.
 
-The loop is a real closed control loop, not a synthetic kernel drill:
+The loop is a real closed control loop:
 
-  churn     — every tick, CHURN random objects get new upstream specs
-              (the informer event stream; host mirror updated to match)
-  reconcile — the device re-decides ALL rows and returns a compact patch
-              set (actionable rows only) + global stats
-  apply     — the host applier turns collected patches into downstream
-              sync events (side=down, value = host's upstream object) and
-              ships them back in a later tick's delta batch — dirty rows
+  churn     — every core tick, CHURN random rows get new upstream specs
+              (the informer event stream), enqueued key-by-key through
+              the serving work queue
+  reconcile — the core's tick drains the queue, stages the rows, and
+              runs the fused step over ALL rows; the compact patch set
+              pipelines back (copy_to_host_async, collected a tick later)
+  apply     — the owner's ``fused_apply`` (the applier-pool seam) copies
+              upstream -> downstream per patch row and enqueues the sync
+              feedback, which rides a later tick's scatter — rows
               actually converge, exactly like the reference's
               upsertIntoDownstream (pkg/syncer/specsyncer.go:86-132)
 
 A "reconcile" = one object row fully re-decided in a tick (the unit the
 reference spends a goroutine wakeup on, pkg/syncer/syncer.go:227-244).
 
-The link uses the packed wire format (reconcile_step_packed): exactly one
-uint32 upload and one int32 download per tick, software-pipelined —
-uploads issued UPLOAD_LEAD ticks ahead, downloads collected FETCH_DEPTH
-ticks later via copy_to_host_async — so steady-state tick time is set by
-device work + link bandwidth, not per-RPC round-trip latency.
+Convergence is sampled per patch batch: from the latest churn stamp of
+its rows to the second dispatch after the batch's sync feedback was
+enqueued — by then the tick that scattered the feedback has had its own
+wire collected, so the sample is proven against device data, not host
+bookkeeping. p99 reports against BASELINE.json's < 200 ms target.
 
-Convergence is measured END TO END per churned row: from the moment the
-new spec exists on the host to the collect of the tick whose delta batch
-carried that row's downstream sync event — that collect blocks on output
-data that is data-dependent on the sync scatter, so it proves the row
-converged on device. p99 is reported against BASELINE.json's < 200 ms
-target.
+Not measured here (the host json-encode path): the per-object dict ->
+tensor encode runs in `BatchSyncEngine.fused_encode` in production; the
+suite's schema-hash lane and tests/test_native.py cover it.
 
 Prints exactly one JSON line:
     {"metric": "reconciles_per_sec", "value": ..., "unit": "rows/s",
@@ -44,6 +45,7 @@ a target set for a v5e-8; this harness uses ONE chip.)
 
 from __future__ import annotations
 
+import asyncio
 import json
 import sys
 import time
@@ -51,167 +53,161 @@ import time
 import numpy as np
 
 
+class _BenchOwner:
+    """Synthetic SectionOwner: mirror arrays instead of informer caches,
+    mirror copies instead of store writes. Everything between — queue,
+    staging, fused step, pipeline, dispatch — is the serving code."""
+
+    def __init__(self, core, b: int, s: int, seed: int = 7):
+        self.core = core
+        self.B, self.S = b, s
+        self.rng = np.random.default_rng(seed)
+        # status slots: the top s//8 columns, as example_state lays out
+        mask = np.zeros(s, bool)
+        mask[-max(1, s // 8):] = True
+        self._mask = mask
+        self.section = core.register(self, s)
+        bucket = self.section.bucket
+        for i in range(b):
+            self.section.row_for(i)
+        bucket.up_vals[:b] = self.rng.integers(1, 2**32, (b, s), dtype=np.uint32)
+        bucket.down_vals[:b] = bucket.up_vals[:b]
+        flip = self.rng.random(b) < 0.005
+        bucket.down_vals[:b][flip, :1] ^= 1
+        bucket.up_exists[:b] = True
+        bucket.down_exists[:b] = True
+        bucket.mark_stale()
+        self.bucket = bucket
+        self.t_create = np.full(b, time.perf_counter())
+        self.dispatches = 0
+        self.lat_ms: list[float] = []
+        self.patch_rows = 0
+        # (sample_at_dispatch, t_create snapshot) awaiting scatter proof
+        self._awaiting: list[tuple[int, np.ndarray]] = []
+
+    # --------------------------------------------- SectionOwner interface
+
+    def fused_status_mask(self) -> np.ndarray:
+        return self._mask
+
+    def fused_encode(self, key: int):
+        b = self.bucket
+        return b.up_vals[key], True, b.down_vals[key], True
+
+    def fused_overflow(self) -> None:  # pragma: no cover - fixed vocab
+        raise AssertionError("bench vocabulary never grows")
+
+    def fused_apply(self, patches) -> None:
+        """The applier seam: sync each patch row downstream and enqueue
+        the feedback event; close out convergence samples proven by this
+        dispatch."""
+        self.dispatches += 1
+        now = time.perf_counter()
+        while self._awaiting and self._awaiting[0][0] <= self.dispatches:
+            _, created = self._awaiting.pop(0)
+            self.lat_ms.extend((now - created) * 1e3)
+        rows = np.fromiter((k for k, _c, _u in patches), np.int32, len(patches))
+        self.patch_rows += rows.size
+        self.bucket.down_vals[rows] = self.bucket.up_vals[rows]
+        # sample two dispatches out: by then the tick that scattered this
+        # feedback has itself been collected (FIFO pipeline, depth 1)
+        self._awaiting.append((self.dispatches + 2, self.t_create[rows].copy()))
+        enqueue = self.core.enqueue
+        section = self.section
+        for k in rows.tolist():
+            enqueue(section, True, k)
+
+    # ------------------------------------------------------------- churn
+
+    def emit_churn(self, n: int) -> None:
+        rows = self.rng.choice(self.B, size=n, replace=False)
+        self.bucket.up_vals[rows] = self.rng.integers(
+            1, 2**32, (n, self.S), dtype=np.uint32)
+        self.t_create[rows] = time.perf_counter()
+        enqueue = self.core.enqueue
+        section = self.section
+        for k in rows.tolist():
+            enqueue(section, False, k)
+
+
 def main() -> int:
     import jax
 
-    from kcp_tpu.models.reconcile_model import (
-        ReconcileDeltas,
-        example_state,
-        pack_deltas,
-        reconcile_step_packed,
-        unpack_patches,
-    )
+    from kcp_tpu.syncer.core import FusedCore
 
     TENANTS = 10_000
     B = 131_072  # ~13 objects per logical cluster, pow2-padded
     S = 64
-    R = 10_000  # root deployments (configs[2]: 10k workspaces)
-    P = 8  # physical clusters
-    C = 64  # cluster selectors in the fan-out lane
-    D = 2_048  # delta events per tick (churn + sync feedback + padding)
     CHURN = 768  # new upstream-spec events per tick
-    K = 8_192  # patch-set capacity per tick
-    UPLOAD_LEAD = 1  # ticks a delta upload is issued ahead of its step
-    FETCH_DEPTH = 2  # ticks between a step and collecting its patches
-    WARMUP, SETTLE = 8, 16
-    MEASURE_BUDGET_S = 30.0  # adaptive: ITERS chosen to fill this window
-    MIN_ITERS, MAX_ITERS = 30, 600
+    WARMUP_TICKS = 24
+    MEASURE_BUDGET_S = 30.0
+    MIN_TICKS = 30
 
     dev = jax.devices()[0]
     print(f"bench device: {dev}", file=sys.stderr)
 
-    state = example_state(b=B, s=S, r=R, p=P, l=8, c=C, dirty_frac=0.005)
-    # host's authoritative upstream mirror (the applier's object store
-    # analog) — must match example_state's construction
-    up_h = np.asarray(state.up_vals).copy()
-    state = jax.tree.map(jax.device_put, state)
+    async def run() -> dict:
+        core = FusedCore(batch_window=0.0005)
+        owner = _BenchOwner(core, B, S)
+        bucket = owner.bucket
+        bucket.patch_capacity = 8192
+        await core.start()
 
-    rng = np.random.default_rng(7)
-    backlog: list[np.ndarray] = []  # patch rows queued for a sync event
-    pending = np.zeros(B, bool)  # rows queued or with a sync in flight
-    t_create = np.full(B, time.perf_counter())  # latest churn time per row
+        async def churn_pump(until: float) -> None:
+            """One churn batch per core tick (event stream pacing)."""
+            last = -1
+            while time.perf_counter() < until:
+                t = bucket.stats["ticks"]
+                if t != last:
+                    last = t
+                    owner.emit_churn(CHURN)
+                await asyncio.sleep(0.0002)
 
-    def make_batch() -> tuple[np.ndarray, np.ndarray, np.ndarray]:
-        """One tick's event batch (packed), its sync rows, and the
-        creation times of the churn each sync event converges."""
-        churn_idx = rng.choice(B, size=CHURN, replace=False).astype(np.int32)
-        churn_vals = rng.integers(1, 2**32, size=(CHURN, S), dtype=np.uint32)
-        up_h[churn_idx] = churn_vals
-        t_create[churn_idx] = time.perf_counter()
+        # warmup: first compile + full upload + pipeline fill
+        t0 = time.perf_counter()
+        owner.emit_churn(CHURN)
+        while bucket.stats["ticks"] < WARMUP_TICKS:
+            owner.emit_churn(CHURN)
+            await asyncio.sleep(0.002)
+        warmup_s = time.perf_counter() - t0
+        print(f"warmup: {WARMUP_TICKS} ticks in {warmup_s:.1f}s", file=sys.stderr)
 
-        sync_cap = D - CHURN
-        pend = backlog.pop(0) if backlog else np.empty(0, np.int32)
-        # rows churned this tick will re-appear in a later patch set;
-        # syncing them now would race the in-flight churn
-        requeue = np.isin(pend, churn_idx)
-        pending[pend[requeue]] = False
-        pend = pend[~requeue]
-        sync_idx, rest = pend[:sync_cap], pend[sync_cap:]
-        if rest.size:
-            backlog.insert(0, rest)
+        owner.lat_ms.clear()
+        owner.patch_rows = 0
+        tick0 = bucket.stats["ticks"]
+        t0 = time.perf_counter()
+        await churn_pump(t0 + MEASURE_BUDGET_S)
+        # let in-flight ticks land before reading counters
+        while core._inflight:
+            await asyncio.sleep(0.002)
+        dt = time.perf_counter() - t0
+        ticks = bucket.stats["ticks"] - tick0
+        await core.stop()
 
-        n = CHURN + sync_idx.size
-        idx = np.zeros(D, np.int32)
-        vals = np.zeros((D, S), np.uint32)
-        side = np.zeros(D, bool)
-        valid = np.zeros(D, bool)
-        idx[:CHURN] = churn_idx
-        vals[:CHURN] = churn_vals
-        idx[CHURN:n] = sync_idx
-        vals[CHURN:n] = up_h[sync_idx]
-        side[CHURN:n] = True  # sync events target the downstream mirror
-        valid[:n] = True
-        packed = pack_deltas(ReconcileDeltas(
-            idx=idx, vals=vals, exists=np.ones(D, bool), side=side, valid=valid
-        ))
-        # creation times are captured NOW: a row re-churned while this sync
-        # is in flight must not re-stamp this sample (the sync still
-        # converges the value this batch carries)
-        return packed, sync_idx, t_create[sync_idx].copy()
+        if ticks < MIN_TICKS:
+            print(f"warning: only {ticks} ticks in {dt:.1f}s", file=sys.stderr)
+        per_tick = dt / max(ticks, 1)
+        lat = np.asarray(owner.lat_ms) if owner.lat_ms else np.zeros(1)
+        p50, p99 = np.percentile(lat, [50, 99])
+        print(
+            f"tick={per_tick * 1e3:.3f} ms | rows={B} (={TENANTS} tenants) | "
+            f"ticks={ticks} | events/tick~{CHURN}x2 | "
+            f"patches/tick={owner.patch_rows / max(ticks, 1):.0f} | "
+            f"full_uploads={bucket.stats['full_uploads']} | "
+            f"spec->status convergence p50={p50:.1f} ms p99={p99:.1f} ms "
+            f"(target p99 < 200 ms)",
+            file=sys.stderr,
+        )
+        rps = B / per_tick
+        return {
+            "metric": "reconciles_per_sec",
+            "value": round(rps),
+            "unit": "rows/s",
+            "vs_baseline": round(rps / 1_000_000, 3),
+        }
 
-    step = jax.jit(
-        reconcile_step_packed, donate_argnums=(0,),
-        static_argnames=("patch_capacity",),
-    )
-
-    lat_ms: list[float] = []
-    applied = [0]
-
-    def collect(item) -> None:
-        """Block on one in-flight tick: finalize convergence samples for
-        the sync events it carried (the wire read proves the scatter ran)
-        and queue its newly-dirty patch rows for syncing."""
-        wire, synced, created = item
-        idx, _code, _upsync, _overflow, _stats = unpack_patches(np.asarray(wire))
-        now = time.perf_counter()
-        if synced.size:
-            lat_ms.extend((now - created) * 1e3)
-            pending[synced] = False  # re-churned rows may now re-enqueue
-        fresh = idx[~pending[idx]].astype(np.int32)
-        pending[fresh] = True
-        backlog.append(fresh)
-        applied[0] += fresh.size
-
-    upload_q: list[tuple[object, np.ndarray]] = []
-    in_flight: list[tuple[object, np.ndarray]] = []
-
-    def tick():
-        nonlocal state
-        b, sync_rows, created = make_batch()
-        upload_q.append((jax.device_put(b), sync_rows, created))
-        dev_batch, synced, created = upload_q.pop(0)  # issued UPLOAD_LEAD ticks ago
-        state, wire = step(state, dev_batch, patch_capacity=K)
-        wire.copy_to_host_async()
-        in_flight.append((wire, synced, created))
-        if len(in_flight) > FETCH_DEPTH:
-            collect(in_flight.pop(0))
-
-    # fill the upload lead so steady-state ticks consume LEAD-old batches
-    for _ in range(UPLOAD_LEAD):
-        b, sync_rows, created = make_batch()
-        upload_q.append((jax.device_put(b), sync_rows, created))
-
-    for i in range(WARMUP):
-        tick()
-    jax.block_until_ready(state)
-
-    # adaptive iteration count: size the measured run to MEASURE_BUDGET_S
-    # so a slow start (cold tunnel, first-compile) still completes
-    t0 = time.perf_counter()
-    for _ in range(SETTLE):
-        tick()
-    jax.block_until_ready(state)
-    settle_tick = (time.perf_counter() - t0) / SETTLE
-    ITERS = max(MIN_ITERS, min(MAX_ITERS, int(MEASURE_BUDGET_S / max(settle_tick, 1e-6))))
-    print(f"settle tick={settle_tick * 1e3:.3f} ms -> ITERS={ITERS}", file=sys.stderr)
-    lat_ms.clear()
-    applied[0] = 0
-
-    t0 = time.perf_counter()
-    for _ in range(ITERS):
-        tick()
-    jax.block_until_ready(state)
-    dt = time.perf_counter() - t0
-    while in_flight:
-        collect(in_flight.pop(0))
-
-    per_tick = dt / ITERS
-    reconciles_per_sec = B / per_tick
-    p50, p99 = np.percentile(lat_ms, [50, 99])
-    print(
-        f"tick={per_tick * 1e3:.3f} ms | rows={B} (={TENANTS} tenants) | "
-        f"splitter {R}x{P} | fanout {B}x{C} | events {D}/tick "
-        f"(churn {CHURN} + sync feedback) | patches/tick={applied[0] / ITERS:.0f} | "
-        f"spec->status convergence p50={p50:.1f} ms p99={p99:.1f} ms "
-        f"(target p99 < 200 ms)",
-        file=sys.stderr,
-    )
-    print(json.dumps({
-        "metric": "reconciles_per_sec",
-        "value": round(reconciles_per_sec),
-        "unit": "rows/s",
-        "vs_baseline": round(reconciles_per_sec / 1_000_000, 3),
-    }))
+    result = asyncio.run(run())
+    print(json.dumps(result))
     return 0
 
 

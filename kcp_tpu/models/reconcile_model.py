@@ -47,7 +47,7 @@ class ReconcileState(NamedTuple):
     up_exists: jax.Array  # bool [B]
     down_vals: jax.Array  # uint32 [B, S]
     down_exists: jax.Array  # bool [B]
-    status_mask: jax.Array  # bool [S]
+    status_mask: jax.Array  # bool [S] (bucket-wide) or [B, S] (per-row)
     replicas: jax.Array  # int32 [R]
     avail: jax.Array  # bool [R, P]
     current: jax.Array  # int32 [R, P] currently-applied leaf replicas

@@ -45,11 +45,15 @@ def sync_decisions(
     up_exists: jax.Array,  # bool  [B]
     down_vals: jax.Array,  # uint32 [B, S] downstream encodings
     down_exists: jax.Array,  # bool [B]
-    status_mask: jax.Array,  # bool [S] True for status.* slots
+    status_mask: jax.Array,  # bool [S] or [B, S]: True for status.* slots
 ) -> SyncDecisions:
+    """``status_mask`` may be per-bucket ([S]) or per-row ([B, S]) — the
+    fused serving core packs rows from engines with different slot
+    vocabularies into one bucket, so each row carries its owner's mask."""
+    mask = status_mask if status_mask.ndim == 2 else status_mask[None, :]
     neq = up_vals != down_vals  # [B, S]
-    spec_dirty = (neq & ~status_mask[None, :]).any(axis=-1)
-    status_dirty = (neq & status_mask[None, :]).any(axis=-1)
+    spec_dirty = (neq & ~mask).any(axis=-1)
+    status_dirty = (neq & mask).any(axis=-1)
 
     both = up_exists & down_exists
     decision = jnp.where(

@@ -125,10 +125,13 @@ def state_shardings(mesh: Mesh) -> dict[str, NamedSharding]:
     }
 
 
-def state_sharding_tree(mesh: Mesh):
+def state_sharding_tree(mesh: Mesh, row_status_mask: bool = False):
     """A ReconcileState pytree of NamedShardings — THE single source of
     truth for how reconcile state is laid out on a mesh (used by
-    shard_state, jit out_shardings, and the sharding tests)."""
+    shard_state, jit out_shardings, and the sharding tests).
+
+    ``row_status_mask`` selects the [B, S] per-row mask layout (the fused
+    serving core's heterogeneous-vocabulary buckets)."""
     from ..models.reconcile_model import ReconcileState
 
     sh = state_shardings(mesh)
@@ -137,7 +140,7 @@ def state_sharding_tree(mesh: Mesh):
         up_exists=sh["flags"],
         down_vals=sh["rows"],
         down_exists=sh["flags"],
-        status_mask=sh["slot_mask"],
+        status_mask=sh["rows"] if row_status_mask else sh["slot_mask"],
         replicas=sh["placement_rows"],
         avail=sh["placement"],
         current=sh["placement"],
@@ -148,5 +151,7 @@ def state_sharding_tree(mesh: Mesh):
 
 def shard_state(state, mesh: Mesh):
     """device_put a ReconcileState pytree with the canonical shardings."""
-    tree = state_sharding_tree(mesh)
+    tree = state_sharding_tree(
+        mesh, row_status_mask=np.asarray(state.status_mask).ndim == 2
+    )
     return jax.tree.map(jax.device_put, state, tree)

@@ -14,6 +14,7 @@ from __future__ import annotations
 import asyncio
 import heapq
 import time
+from collections import deque
 from typing import Hashable
 
 Item = Hashable
@@ -25,7 +26,7 @@ MAX_DELAY = 1000.0
 class WorkQueue:
     def __init__(self, name: str = "queue"):
         self.name = name
-        self._ready: list[Item] = []
+        self._ready: deque[Item] = deque()
         self._pending: set[Item] = set()  # dedup: queued or scheduled
         self._processing: set[Item] = set()
         self._redo: set[Item] = set()  # re-added while processing
@@ -94,7 +95,7 @@ class WorkQueue:
         while True:
             next_due = self._promote_delayed()
             if self._ready:
-                item = self._ready.pop(0)
+                item = self._ready.popleft()
                 self._pending.discard(item)
                 self._processing.add(item)
                 return item
@@ -123,7 +124,7 @@ class WorkQueue:
         while len(batch) < max_items:
             self._promote_delayed()
             if self._ready:
-                item = self._ready.pop(0)
+                item = self._ready.popleft()
                 self._pending.discard(item)
                 self._processing.add(item)
                 batch.append(item)

@@ -1,0 +1,469 @@
+"""FusedCore — the served control plane runs the flagship device program.
+
+The reference runs one goroutine pair per (cluster, GVR)
+(pkg/syncer/syncer.go:46-64 StartSyncer); round 1 of this build ran one
+small device program per (cluster, GVR). This module closes the gap
+between the benched program and the served one: every sync engine in the
+process registers a row *section* inside a shared schema bucket, and each
+reconcile tick runs ONE fused ``reconcile_step_packed`` per bucket —
+resident donated state, packed one-array-each-way wire format, pipelined
+collection — exactly the artifact ``bench.py`` measures.
+
+Topology:
+
+  FusedCore ── one per asyncio loop (the process's serving loop)
+    ├── BatchController      one tick loop draining all engines' events
+    └── FusedBucket(S)       one per slot capacity (the schema bucket)
+          ├── ReconcileState device-resident [B, S] mirrors + per-row
+          │                  status masks (engines have different slot
+          │                  vocabularies, so masks are [B, S])
+          └── Section        one per engine: a set of rows + callbacks
+
+Tick pipeline (the UPLOAD_LEAD/FETCH_DEPTH structure proven in bench.py):
+
+  drain events -> engines encode touched keys -> bucket stages rows
+    -> pack ONE uint32 delta array, device_put, step (donated), wire out
+    -> wire.copy_to_host_async(); collection happens a tick later (or via
+       the idle flusher) without blocking the loop
+    -> unpack patches, route rows to owning sections, engines' appliers
+       take it from there (also without blocking the tick)
+
+Patch overflow: the wire carries at most ``patch_capacity`` actionable
+rows. Because the loop is level-triggered (every tick re-decides every
+row), overflow loses nothing — the core doubles capacity (one recompile)
+and re-ticks.
+
+Mesh serving: pass ``mesh=`` to shard every bucket's state over a
+(tenants, slots) device mesh — same layout as ``parallel/mesh.py`` and
+``dryrun_multichip``. Stats reductions lower to cross-device collectives;
+the packed wire batch is replicated (it is O(events), not O(fleet)).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Callable, Protocol, Sequence
+
+import jax
+import numpy as np
+
+from ..models.reconcile_model import (
+    PACK_HDR,
+    ReconcileState,
+    pack_deltas,
+    ReconcileDeltas,
+    reconcile_step_packed,
+    unpack_patches,
+)
+from ..ops.encode import pad_pow2
+from ..reconciler.controller import BatchController
+
+log = logging.getLogger(__name__)
+
+MIN_ROWS = 64
+MIN_EVENTS = 64
+MIN_PATCH_CAPACITY = 256
+FETCH_DEPTH = 1  # in-flight ticks before a blocking collect
+IDLE_FLUSH_S = 0.003  # collect leftovers when no new tick arrives
+
+
+class SectionOwner(Protocol):
+    """What an engine provides to its section (see BatchSyncEngine)."""
+
+    def fused_encode(self, key) -> tuple[np.ndarray, bool, np.ndarray, bool]:
+        """(up_vals[S], up_exists, down_vals[S], down_exists) for a key,
+        re-read from the informer caches. May raise BucketOverflow."""
+        ...
+
+    def fused_status_mask(self) -> np.ndarray:
+        """bool[S] — the engine's current status-slot mask."""
+        ...
+
+    def fused_apply(self, patches: list[tuple[object, int, bool]]) -> None:
+        """Receive (key, decision_code, upsync) patches for this engine's
+        rows. Must not block the loop (hand off to an applier pool)."""
+        ...
+
+    def fused_overflow(self) -> None:
+        """The engine's slot vocabulary outgrew its bucket: grow the
+        encoder, re-register in a larger bucket, replay all rows."""
+        ...
+
+
+class Section:
+    """One engine's row allocation inside a bucket."""
+
+    def __init__(self, bucket: "FusedBucket", owner: SectionOwner):
+        self.bucket = bucket
+        self.owner = owner
+        self.rows: dict[object, int] = {}  # key -> global row
+        self.row_keys: dict[int, object] = {}  # global row -> key
+        # seed the mask cache now: row_for stamps every new row with the
+        # current mask, so refresh_mask must only fire on real changes
+        self._mask: np.ndarray = owner.fused_status_mask().copy()
+
+    def row_for(self, key) -> int:
+        row = self.rows.get(key)
+        if row is None:
+            row = self.bucket.alloc_row(self)
+            self.rows[key] = row
+            self.row_keys[row] = key
+            # stamp with the cached mask; refresh_mask restamps everything
+            # if the owner's vocabulary has drifted since
+            self.bucket.status_mask[row, : self._mask.shape[0]] = self._mask
+        return row
+
+    def refresh_mask(self) -> None:
+        """Restamp this section's rows after the owner's vocabulary grew
+        new status slots (rare; triggers a full re-upload)."""
+        mask = self.owner.fused_status_mask()
+        if np.array_equal(self._mask, mask):
+            return
+        self._mask = mask.copy()
+        for row in self.rows.values():
+            self.bucket.status_mask[row] = False
+            self.bucket.status_mask[row, : mask.shape[0]] = mask
+        self.bucket.mark_stale()
+
+    def release(self) -> None:
+        for row in self.rows.values():
+            self.bucket.free_row(row)
+        self.rows.clear()
+        self.row_keys.clear()
+
+
+class FusedBucket:
+    """One schema bucket: host staging + device-resident fused state."""
+
+    def __init__(self, slots: int, mesh=None):
+        self.S = slots
+        self.B = 0
+        self.mesh = mesh
+        self.up_vals = np.zeros((0, slots), np.uint32)
+        self.down_vals = np.zeros((0, slots), np.uint32)
+        self.up_exists = np.zeros(0, bool)
+        self.down_exists = np.zeros(0, bool)
+        self.status_mask = np.zeros((0, slots), bool)
+        self.sections: list[Section] = []
+        self.row_owner: dict[int, Section] = {}
+        self._free: list[int] = []
+        self._next = 0
+        self._state: ReconcileState | None = None
+        self._stale = True
+        self.patch_capacity = MIN_PATCH_CAPACITY
+        # staged events for the next tick: (row, side) -> (vals, exists)
+        self._staged: dict[tuple[int, bool], tuple[np.ndarray, bool]] = {}
+        self._step = jax.jit(
+            reconcile_step_packed, donate_argnums=(0,),
+            static_argnames=("patch_capacity",),
+        )
+        self.stats = {"ticks": 0, "full_uploads": 0, "overflows": 0}
+
+    # ------------------------------------------------------------- rows
+
+    def section(self, owner: SectionOwner) -> Section:
+        s = Section(self, owner)
+        self.sections.append(s)
+        return s
+
+    def alloc_row(self, section: Section) -> int:
+        if self._free:
+            row = self._free.pop()
+        else:
+            if self._next >= self.B:
+                self._grow(self._next + 1)
+            row = self._next
+            self._next += 1
+        self.row_owner[row] = section
+        return row
+
+    def free_row(self, row: int) -> None:
+        self.up_exists[row] = self.down_exists[row] = False
+        self.up_vals[row] = self.down_vals[row] = 0
+        self.row_owner.pop(row, None)
+        self._free.append(row)
+        self.mark_stale()
+
+    def _grow(self, needed: int) -> None:
+        new_b = pad_pow2(max(needed, MIN_ROWS))
+
+        def grow(a, shape, dtype):
+            out = np.zeros(shape, dtype)
+            out[: a.shape[0], ...] = a
+            return out
+
+        self.up_vals = grow(self.up_vals, (new_b, self.S), np.uint32)
+        self.down_vals = grow(self.down_vals, (new_b, self.S), np.uint32)
+        self.up_exists = grow(self.up_exists, (new_b,), bool)
+        self.down_exists = grow(self.down_exists, (new_b,), bool)
+        self.status_mask = grow(self.status_mask, (new_b, self.S), bool)
+        self.B = new_b
+        self.mark_stale()
+
+    def mark_stale(self) -> None:
+        self._stale = True
+
+    # ------------------------------------------------------------ events
+
+    def stage(self, row: int, side: bool, vals: np.ndarray, exists: bool) -> None:
+        """Stage one delta event (last-wins per (row, side)) and mirror it
+        into host staging (the rebuild source of truth)."""
+        self._staged[(row, side)] = (vals, exists)
+        if side:
+            self.down_vals[row, : vals.shape[0]] = vals
+            self.down_vals[row, vals.shape[0]:] = 0
+            self.down_exists[row] = exists
+        else:
+            self.up_vals[row, : vals.shape[0]] = vals
+            self.up_vals[row, vals.shape[0]:] = 0
+            self.up_exists[row] = exists
+
+    @property
+    def dirty(self) -> bool:
+        return bool(self._staged) or self._stale
+
+    # -------------------------------------------------------------- tick
+
+    def _device_state(self) -> ReconcileState:
+        # minimal splitter/fanout lanes: the sync serving path doesn't use
+        # them, but the program IS the flagship step, lanes and all
+        r, p, l, c = 8, 8, 1, 8
+        state = ReconcileState(
+            up_vals=self.up_vals, up_exists=self.up_exists,
+            down_vals=self.down_vals, down_exists=self.down_exists,
+            status_mask=self.status_mask,
+            replicas=np.zeros(r, np.int32),
+            avail=np.zeros((r, p), bool),
+            current=np.zeros((r, p), np.int32),
+            pair_hashes=np.zeros((self.B, l), np.uint32),
+            sel_hashes=np.zeros(c, np.uint32),
+        )
+        if self.mesh is not None:
+            from ..parallel.mesh import shard_state
+
+            return shard_state(state, self.mesh)
+        return jax.tree.map(jax.device_put, state)
+
+    def submit(self) -> jax.Array | None:
+        """Upload staged events, run one fused step, return the wire array
+        (with copy_to_host_async issued). None if nothing to do."""
+        if not self.dirty:
+            return None
+        if self._stale:
+            self._state = self._device_state()
+            self._stale = False
+            self._staged.clear()
+            self.stats["full_uploads"] += 1
+            # full upload replaces the mirrors wholesale; still run the
+            # step so decisions for the new state come back
+            d = MIN_EVENTS
+            deltas = ReconcileDeltas(
+                idx=np.zeros(d, np.int32),
+                vals=np.zeros((d, self.S), np.uint32),
+                exists=np.zeros(d, bool),
+                side=np.zeros(d, bool),
+                valid=np.zeros(d, bool),
+            )
+        else:
+            staged = self._staged
+            self._staged = {}
+            d = pad_pow2(len(staged), floor=MIN_EVENTS)
+            idx = np.zeros(d, np.int32)
+            vals = np.zeros((d, self.S), np.uint32)
+            exists = np.zeros(d, bool)
+            side = np.zeros(d, bool)
+            valid = np.zeros(d, bool)
+            for i, ((row, sd), (v, ex)) in enumerate(staged.items()):
+                idx[i] = row
+                vals[i, : v.shape[0]] = v
+                exists[i] = ex
+                side[i] = sd
+                valid[i] = True
+            deltas = ReconcileDeltas(idx, vals, exists, side, valid)
+        packed = pack_deltas(deltas)
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            packed = jax.device_put(packed, NamedSharding(self.mesh, PartitionSpec()))
+        else:
+            packed = jax.device_put(packed)
+        self._state, wire = self._step(
+            self._state, packed, patch_capacity=min(self.patch_capacity, self.B)
+        )
+        wire.copy_to_host_async()
+        self.stats["ticks"] += 1
+        return wire
+
+    def dispatch(self, wire: np.ndarray) -> bool:
+        """Route a collected wire's patches to owning sections.
+
+        Returns True if the patch set overflowed (caller re-ticks after
+        doubling capacity)."""
+        idx, code, upsync, overflow, _stats = unpack_patches(wire)
+        per_section: dict[Section, list[tuple[object, int, bool]]] = {}
+        for r, c, u in zip(idx.tolist(), code.tolist(), upsync.tolist()):
+            s = self.row_owner.get(r)
+            if s is None:
+                continue
+            key = s.row_keys.get(r)
+            if key is not None:
+                per_section.setdefault(s, []).append((key, c, u))
+        for s, patches in per_section.items():
+            s.owner.fused_apply(patches)
+        if overflow:
+            self.stats["overflows"] += 1
+            self.patch_capacity = min(self.patch_capacity * 2, max(self.B, MIN_ROWS))
+        return bool(overflow)
+
+
+class FusedCore:
+    """The per-loop serving core: one tick loop over all fused buckets."""
+
+    _instances: dict[int, "FusedCore"] = {}
+
+    def __init__(self, mesh=None, batch_window: float = 0.002):
+        self.mesh = mesh
+        self.buckets: dict[int, FusedBucket] = {}
+        self.controller = BatchController(
+            "fused-core", self._process_batch, batch_window=batch_window
+        )
+        self._inflight: list[tuple[FusedBucket, jax.Array]] = []
+        self._flush_task: asyncio.Task | None = None
+        self._refs = 0
+        self._started = False
+
+    # ---------------------------------------------------------- lifecycle
+
+    @classmethod
+    def for_current_loop(cls, mesh=None) -> "FusedCore":
+        """The process-wide core for the running asyncio loop (tests run
+        many loops sequentially; each gets a fresh core)."""
+        try:
+            loop_id = id(asyncio.get_running_loop())
+        except RuntimeError:
+            loop_id = 0
+        core = cls._instances.get(loop_id)
+        if core is None or core._closed():
+            core = cls(mesh=mesh)
+            cls._instances[loop_id] = core
+        return core
+
+    def _closed(self) -> bool:
+        return self._started and self._refs == 0
+
+    async def start(self) -> None:
+        self._refs += 1
+        if not self._started:
+            self._started = True
+            await self.controller.start()
+
+    async def stop(self) -> None:
+        self._refs -= 1
+        if self._refs > 0:
+            return
+        if self._flush_task is not None:
+            self._flush_task.cancel()
+            self._flush_task = None
+        await self._drain_inflight()
+        await self.controller.stop()
+
+    # ------------------------------------------------------------ plumbing
+
+    def bucket(self, slots: int) -> FusedBucket:
+        b = self.buckets.get(slots)
+        if b is None:
+            b = FusedBucket(slots, mesh=self.mesh)
+            self.buckets[slots] = b
+        return b
+
+    def register(self, owner: SectionOwner, slots: int) -> Section:
+        return self.bucket(slots).section(owner)
+
+    def enqueue(self, section: Section, side: bool, key) -> None:
+        self.controller.enqueue((id(section.owner), side, key, section))
+
+    # ---------------------------------------------------------------- tick
+
+    async def _process_batch(self, items: Sequence) -> list:
+        # 1. encode touched keys (engines re-read their informer caches);
+        #    section=None items are retick markers — their bucket is
+        #    already marked stale and will re-run on this tick
+        touched: dict[Section, set] = {}
+        for _oid, _side, key, section in items:
+            if section is not None:
+                touched.setdefault(section, set()).add(key)
+        for section, keys in touched.items():
+            self._encode_section(section, keys)
+
+        # 2. one fused step per dirty bucket; collection is pipelined
+        for bucket in self.buckets.values():
+            wire = bucket.submit()
+            if wire is not None:
+                self._inflight.append((bucket, wire))
+
+        # 3. collect: per BUCKET, oldest in-flight wires beyond FETCH_DEPTH
+        #    (blocking is fine by then — their data has had a full tick to
+        #    land). Depth is per bucket so one bucket's fresh wire never
+        #    forces a zero-depth blocking collect of another's.
+        counts: dict[int, int] = {}
+        for b, _w in self._inflight:
+            counts[id(b)] = counts.get(id(b), 0) + 1
+        i = 0
+        while i < len(self._inflight):
+            b, w = self._inflight[i]
+            if counts[id(b)] > FETCH_DEPTH:
+                self._inflight.pop(i)
+                counts[id(b)] -= 1
+                self._collect(b, w)
+            else:
+                i += 1
+        self._schedule_flush()
+        return []
+
+    def _encode_section(self, section: Section, keys) -> None:
+        from ..ops.encode import BucketOverflow
+
+        for key in keys:
+            try:
+                up_v, up_e, down_v, down_e = section.owner.fused_encode(key)
+            except BucketOverflow:
+                # engine's vocabulary outgrew this bucket: the engine
+                # re-registers in a larger bucket and replays its rows
+                section.owner.fused_overflow()
+                return
+            row = section.row_for(key)
+            section.bucket.stage(row, False, up_v, up_e)
+            section.bucket.stage(row, True, down_v, down_e)
+        section.refresh_mask()
+
+    def _collect(self, bucket: FusedBucket, wire: jax.Array) -> None:
+        overflow = bucket.dispatch(np.asarray(wire))
+        if overflow:
+            # level-triggered: re-run the bucket with doubled capacity
+            bucket.mark_stale()
+            self.controller.queue.add(("__retick__", False, id(bucket), None))
+
+    def _schedule_flush(self) -> None:
+        if self._flush_task is not None:
+            self._flush_task.cancel()
+        self._flush_task = asyncio.create_task(self._idle_flush())
+
+    async def _idle_flush(self) -> None:
+        """Collect remaining in-flight wires once the loop goes quiet —
+        without this, the last tick's patches would wait for the next
+        informer event."""
+        try:
+            await asyncio.sleep(IDLE_FLUSH_S)
+            while self._inflight:
+                bucket, wire = self._inflight[0]
+                while not wire.is_ready():
+                    await asyncio.sleep(0.001)
+                self._inflight.pop(0)
+                self._collect(bucket, wire)
+        except asyncio.CancelledError:
+            pass
+
+    async def _drain_inflight(self) -> None:
+        while self._inflight:
+            self._collect(*self._inflight.pop(0))

@@ -37,12 +37,12 @@ Decision application parity with the reference:
 
 from __future__ import annotations
 
+import asyncio
 import copy
 import logging
 import time
 from typing import Sequence
 
-import jax
 import numpy as np
 
 from ..apis.scheme import GVR
@@ -51,8 +51,6 @@ from ..ops.diff import (
     DECISION_CREATE,
     DECISION_DELETE,
     DECISION_UPDATE,
-    apply_deltas_jit,
-    sync_decisions_jit,
 )
 from ..ops.encode import BucketEncoder, BucketOverflow, pad_pow2
 from ..reconciler.controller import BatchController
@@ -97,10 +95,19 @@ def _sync_view(obj: dict) -> dict:
 class BatchSyncEngine:
     """One batched sync program for one GVR between two clusters.
 
-    ``backend="tpu"`` runs the jitted kernels with device-resident mirrors
-    (on whatever jax platform is active); ``backend="host"`` computes
-    identical decisions in pure Python — the differential-testing
-    reference (SURVEY.md §7.1).
+    ``backend="tpu"`` registers a row section in the process-wide
+    :class:`~kcp_tpu.syncer.core.FusedCore`: every engine's rows live in a
+    shared schema bucket and each reconcile tick runs ONE fused
+    ``reconcile_step_packed`` over the whole fleet — the same program
+    ``bench.py`` measures. ``backend="host"`` computes identical decisions
+    in pure Python per engine — the differential-testing reference
+    (SURVEY.md §7.1).
+
+    Applies are pipelined: the tick never waits on a store write. Patches
+    go to an applier pool that verifies against the live caches, applies
+    with optimistic concurrency, and retries with per-key backoff
+    (5 retries then drop, RetryableError forever — reference parity with
+    pkg/syncer/syncer.go:272-291).
     """
 
     def __init__(
@@ -113,12 +120,17 @@ class BatchSyncEngine:
         namespace_gvr: GVR | str = "namespaces",
         batch_window: float = 0.002,
         resync_period: float | None = DEFAULT_RESYNC_PERIOD,
+        core=None,
+        apply_workers: int = 4,
+        max_apply_retries: int = 5,
     ):
         self.upstream = upstream
         self.downstream = downstream
         self.gvr = gvr
         self.cluster_id = cluster_id
         self.backend = backend
+        self.fused = backend == "tpu"
+        self.core = core
         self.namespace_gvr = namespace_gvr
         self.selector: LabelSelector = parse_selector(f"{CLUSTER_LABEL}={cluster_id}")
 
@@ -133,24 +145,40 @@ class BatchSyncEngine:
         self.rows: dict[tuple[str, str], int] = {}  # (ns, name) -> row
         self.row_keys: list[tuple[str, str]] = []
         self.capacity = 0
-        # host staging mirrors (canonical; also the host-backend state)
+        # host staging mirrors (host-backend state; fused mode stages into
+        # the shared bucket instead)
         self.up_vals = self.up_exists = self.down_vals = self.down_exists = None
-        # device-resident copies (tpu backend), refreshed incrementally
-        self._dev: dict[str, jax.Array] | None = None
-        self._dev_stale = True
-        self._mask_slots = -1
-        self._dev_mask: jax.Array | None = None
 
-        self.controller = BatchController(
-            f"sync-{cluster_id}-{gvr}", self._process_batch, batch_window=batch_window
-        )
+        self.controller = None
+        self._section = None
+        if not self.fused:
+            self.controller = BatchController(
+                f"sync-{cluster_id}-{gvr}", self._process_batch,
+                batch_window=batch_window,
+            )
         self.up_informer.add_handler(self._on_up_event)
         self.down_informer.add_handler(self._on_down_event)
+
+        # pipelined applier pool
+        self.apply_workers = apply_workers
+        self.max_apply_retries = max_apply_retries
+        self._apply_q: asyncio.Queue | None = None
+        self._apply_pending: set = set()
+        self._apply_failures: dict = {}  # key -> consecutive failure count
+        self._apply_tasks: list[asyncio.Task] = []
+        self._retry_tasks: set[asyncio.Task] = set()
 
         # convergence bookkeeping for the p99 metric: key -> first-dirty time
         self.dirty_since: dict[tuple[str, str], float] = {}
         self.convergence_samples: list[float] = []
         self.stats = {"ticks": 0, "decisions_applied": 0, "rows": 0, "full_uploads": 0}
+
+    def tick_count(self) -> int:
+        """Reconcile ticks that covered this engine's rows (fused mode
+        reports the shared bucket's tick counter)."""
+        if self.fused and self._section is not None:
+            return self._section.bucket.stats["ticks"]
+        return self.stats["ticks"]
 
     # ------------------------------------------------------------ events
 
@@ -162,11 +190,130 @@ class BatchSyncEngine:
     def _on_up_event(self, etype: str, old: dict | None, new: dict | None) -> None:
         key = self._obj_key(new or old)
         self.dirty_since.setdefault(key, time.monotonic())
-        self.controller.enqueue(("up", key))
+        self._apply_failures.pop(key, None)  # new data resets the budget
+        if self.fused:
+            if self._section is not None:
+                self.core.enqueue(self._section, False, key)
+        else:
+            self.controller.enqueue(("up", key))
 
     def _on_down_event(self, etype: str, old: dict | None, new: dict | None) -> None:
         key = self._obj_key(new or old)
-        self.controller.enqueue(("down", key))
+        self._apply_failures.pop(key, None)
+        if self.fused:
+            if self._section is not None:
+                self.core.enqueue(self._section, True, key)
+        else:
+            self.controller.enqueue(("down", key))
+
+    # ----------------------------------------------- fused-core interface
+
+    def fused_status_mask(self) -> np.ndarray:
+        return self.enc.status_mask()
+
+    def fused_encode(self, key: tuple[str, str]):
+        """Re-encode one touched key from the informer caches for the
+        shared bucket's scatter. Raises BucketOverflow if the vocabulary
+        outgrew the bucket (the core then calls :meth:`fused_overflow`)."""
+        ns, name = key
+        up_obj = self.up_informer.get(self._up_cluster(), name, ns)
+        down_obj = self.down_informer.get(self._down_cluster(), name, ns)
+        s = self.enc.capacity
+        up_v = (self.enc.encode(_sync_view(up_obj)) if up_obj is not None
+                else np.zeros(s, np.uint32))
+        down_v = (self.enc.encode(_sync_view(down_obj)) if down_obj is not None
+                  else np.zeros(s, np.uint32))
+        # converged-by-observation: both sides present and identical means
+        # this key's churn has landed — close its convergence sample here
+        # (actioned keys close theirs in the applier)
+        if (up_obj is None) == (down_obj is None) and bool((up_v == down_v).all()):
+            self._sample_convergence(key)
+        return up_v, up_obj is not None, down_v, down_obj is not None
+
+    def fused_apply(self, patches: list[tuple[tuple[str, str], int, bool]]) -> None:
+        """Patch rows from a collected tick: feed the applier pool
+        (dedup per key; the pool re-verifies against live caches)."""
+        for key, code, upsync in patches:
+            if key in self._apply_pending:
+                continue
+            if self._apply_failures.get(key, 0) > self.max_apply_retries:
+                continue  # dropped until a new event resets the budget
+            self._apply_pending.add(key)
+            self._apply_q.put_nowait((key, code, upsync))
+
+    def fused_overflow(self) -> None:
+        """Vocabulary outgrew the bucket: grow the encoder (vocab is a
+        prefix, so existing slot assignments stay valid), move to the
+        larger bucket, and replay every cached key."""
+        self.enc = self.enc.grown()
+        log.info("sync-%s-%s: bucket overflow, re-registering at %d slots",
+                 self.cluster_id, self.gvr, self.enc.capacity)
+        old = self._section
+        self._section = self.core.register(self, self.enc.capacity)
+        if old is not None:
+            old.release()
+        for key in self._all_keys():
+            self.core.enqueue(self._section, False, key)
+
+    def _all_keys(self) -> set:
+        keys = {(k[1], k[2]) for k in self.up_informer.cache}
+        keys |= {(k[1], k[2]) for k in self.down_informer.cache}
+        return keys
+
+    def _sample_convergence(self, key) -> None:
+        started = self.dirty_since.pop(key, None)
+        if started is not None:
+            from ..utils.trace import REGISTRY
+
+            dt = time.monotonic() - started
+            self.convergence_samples.append(dt)
+            REGISTRY.histogram("kcp_sync_convergence_seconds",
+                               "spec churn to observed convergence").observe(dt)
+
+    # ----------------------------------------------------- applier pool
+
+    async def _apply_worker(self) -> None:
+        while True:
+            key, code, upsync = await self._apply_q.get()
+            self._apply_pending.discard(key)
+            try:
+                applied = await self._apply_async(key, code, upsync)
+            except Exception as err:  # noqa: BLE001 — reconcile errors are data
+                self._apply_failed(key, code, upsync, err)
+            else:
+                self._apply_failures.pop(key, None)
+                if applied:
+                    self.stats["decisions_applied"] += 1
+            finally:
+                self._apply_q.task_done()
+
+    async def _apply_async(self, key, code: int, upsync: bool) -> bool:
+        """Apply one verified decision. Override (or monkeypatch) to make
+        applies genuinely asynchronous (e.g. thread-pooled REST calls) —
+        the tick loop never waits on this."""
+        return self._apply_decision(key, code, upsync)
+
+    def _apply_failed(self, key, code: int, upsync: bool, err: Exception) -> None:
+        n = self._apply_failures.get(key, 0) + 1
+        self._apply_failures[key] = n  # backoff escalates for every failure
+        retryable = errors.is_retryable(err)
+        if not retryable and n > self.max_apply_retries:
+            log.warning("sync-%s-%s: dropping %r after %d apply retries: %s",
+                        self.cluster_id, self.gvr, key, n - 1, err)
+            return
+        delay = min(0.005 * (2 ** min(n, 10)), 5.0)
+        log.info("sync-%s-%s: apply %r failed (attempt %d): %s",
+                 self.cluster_id, self.gvr, key, n, err)
+        t = asyncio.get_event_loop().create_task(
+            self._retry_apply(key, code, upsync, delay))
+        self._retry_tasks.add(t)
+        t.add_done_callback(self._retry_tasks.discard)
+
+    async def _retry_apply(self, key, code: int, upsync: bool, delay: float) -> None:
+        await asyncio.sleep(delay)
+        if key not in self._apply_pending:
+            self._apply_pending.add(key)
+            self._apply_q.put_nowait((key, code, upsync))
 
     # ------------------------------------------------------------- rows
 
@@ -188,7 +335,6 @@ class BatchSyncEngine:
         self.up_exists = grow(self.up_exists, (new_cap,), bool)
         self.down_exists = grow(self.down_exists, (new_cap,), bool)
         self.capacity = new_cap
-        self._dev_stale = True
 
     def _row_for(self, key: tuple[str, str]) -> int:
         row = self.rows.get(key)
@@ -224,8 +370,6 @@ class BatchSyncEngine:
                 break
             except BucketOverflow:
                 continue
-        self._dev_stale = True
-        self._mask_slots = -1
 
     # -------------------------------------------------------------- tick
 
@@ -257,14 +401,13 @@ class BatchSyncEngine:
             self._rebuild_after_overflow()
             deltas = None
 
-        # 3. full-mirror diff on device (or host reference)
+        # 3. full-mirror diff (pure-host reference backend; the tpu
+        #    backend runs through the FusedCore, not this path)
+        del deltas
         n = len(self.row_keys)
         if n == 0:
             return []
-        if self.backend == "tpu":
-            decision, upsync = self._device_decisions(deltas)
-        else:
-            decision, upsync = self._host_decisions()
+        decision, upsync = self._host_decisions()
 
         # 4. apply non-NOOP rows with host verification
         failed_keys: dict[tuple[str, str], Exception] = {}
@@ -339,47 +482,6 @@ class BatchSyncEngine:
 
     # ---------------------------------------------------------- backends
 
-    def _device_decisions(self, deltas) -> tuple[np.ndarray, np.ndarray]:
-        """Jitted decisions over device-resident mirrors.
-
-        Steady state ships only the padded delta batch over the link;
-        full uploads happen on growth/rebuild only.
-        """
-        if self._dev is None or self._dev_stale:
-            self._dev = {
-                "up_vals": jax.device_put(self.up_vals),
-                "up_exists": jax.device_put(self.up_exists),
-                "down_vals": jax.device_put(self.down_vals),
-                "down_exists": jax.device_put(self.down_exists),
-            }
-            self._dev_stale = False
-            self.stats["full_uploads"] += 1
-        elif deltas is not None:
-            idx, up_rows, up_ex, down_rows, down_ex = deltas
-            d = len(idx)
-            pad = pad_pow2(d)
-            if pad != d:
-                idx = np.pad(idx, (0, pad - d))
-                up_rows = np.pad(up_rows, ((0, pad - d), (0, 0)))
-                up_ex = np.pad(up_ex, (0, pad - d))
-                down_rows = np.pad(down_rows, ((0, pad - d), (0, 0)))
-                down_ex = np.pad(down_ex, (0, pad - d))
-            valid = np.arange(pad) < d
-            self._dev["up_vals"], self._dev["up_exists"] = apply_deltas_jit(
-                self._dev["up_vals"], self._dev["up_exists"], idx, up_rows, up_ex, valid
-            )
-            self._dev["down_vals"], self._dev["down_exists"] = apply_deltas_jit(
-                self._dev["down_vals"], self._dev["down_exists"], idx, down_rows, down_ex, valid
-            )
-        if self._mask_slots != len(self.enc.slot_paths):
-            self._dev_mask = jax.device_put(self.enc.status_mask())
-            self._mask_slots = len(self.enc.slot_paths)
-        d = sync_decisions_jit(
-            self._dev["up_vals"], self._dev["up_exists"],
-            self._dev["down_vals"], self._dev["down_exists"], self._dev_mask,
-        )
-        return np.asarray(d.decision), np.asarray(d.status_upsync)
-
     def _host_decisions(self) -> tuple[np.ndarray, np.ndarray]:
         """Pure-python decision oracle (Backend=host)."""
         n = self.capacity
@@ -435,7 +537,10 @@ class BatchSyncEngine:
                 merged = self._merged_downstream(desired, current)
                 self.downstream.update(self.gvr, merged, namespace=ns)
                 applied = True
-        elif decision == DECISION_DELETE and down_obj is not None:
+        elif decision == DECISION_DELETE and down_obj is not None and up_obj is None:
+            # the up_obj re-check re-derives the action at apply time: a
+            # pipelined DELETE must not fire if the object reappeared
+            # upstream while the patch was in flight
             try:
                 self.downstream.delete(self.gvr, name, ns)
                 applied = True
@@ -487,11 +592,47 @@ class BatchSyncEngine:
     # ---------------------------------------------------------- lifecycle
 
     async def start(self) -> None:
+        self._apply_q = asyncio.Queue()
+        for _ in range(self.apply_workers):
+            self._apply_tasks.append(asyncio.create_task(self._apply_worker()))
+        if self.fused:
+            if self.core is None:
+                from .core import FusedCore
+
+                self.core = FusedCore.for_current_loop()
+            self._section = self.core.register(self, self.enc.capacity)
+            await self.core.start()
+        # informers after the section exists: their initial list replays
+        # the cache through the handlers, which enqueue into the core
         await self.up_informer.start()
         await self.down_informer.start()
-        await self.controller.start()
+        if self.controller is not None:
+            await self.controller.start()
 
     async def stop(self) -> None:
-        await self.controller.stop()
+        if self.controller is not None:
+            await self.controller.stop()
+        if self.fused and self.core is not None:
+            await self.core.stop()
+            if self._section is not None:
+                self._section.release()
+                self._section = None
+        # the core's shutdown drain may have enqueued final patches —
+        # let the workers finish them before cancelling
+        if self._apply_q is not None:
+            try:
+                await asyncio.wait_for(self._apply_q.join(), timeout=5.0)
+            except asyncio.TimeoutError:
+                log.warning("sync-%s-%s: applier queue not drained at stop",
+                            self.cluster_id, self.gvr)
+        for t in [*self._apply_tasks, *self._retry_tasks]:
+            t.cancel()
+        for t in [*self._apply_tasks, *self._retry_tasks]:
+            try:
+                await t
+            except asyncio.CancelledError:
+                pass
+        self._apply_tasks.clear()
+        self._retry_tasks.clear()
         await self.up_informer.stop()
         await self.down_informer.stop()

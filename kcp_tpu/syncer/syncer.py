@@ -71,7 +71,7 @@ class Syncer:
 
     # observability: aggregate convergence + throughput over engines
     def stats(self) -> dict:
-        ticks = sum(e.stats["ticks"] for e in self.engines)
+        ticks = sum(e.tick_count() for e in self.engines)
         applied = sum(e.stats["decisions_applied"] for e in self.engines)
         samples = [s for e in self.engines for s in e.convergence_samples]
         samples.sort()
