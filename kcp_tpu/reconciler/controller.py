@@ -28,6 +28,7 @@ import logging
 from typing import Awaitable, Callable, Iterable, Sequence
 
 from ..utils.errors import is_retryable
+from .fairqueue import make_queue
 from .queue import Item, WorkQueue
 
 log = logging.getLogger(__name__)
@@ -41,7 +42,14 @@ ProcessBatchFn = Callable[[Sequence[Item]], Awaitable[Iterable[tuple[Item, Excep
 
 
 class Controller:
-    """Item-at-a-time controller (the host reference backend)."""
+    """Item-at-a-time controller (the host reference backend).
+
+    The default queue is per-tenant fair (:func:`make_queue`: the native
+    FairWorkQueue when the library loads, plain WorkQueue otherwise).
+    ``tenant_of`` maps an item to its fairness key; the default uses a
+    tuple item's first element — pass a custom extractor when the tenant
+    sits deeper in the item shape.
+    """
 
     def __init__(
         self,
@@ -49,9 +57,12 @@ class Controller:
         process: ProcessFn,
         queue: WorkQueue | None = None,
         max_retries: int = DEFAULT_RETRIES,
+        tenant_of=None,
     ):
         self.name = name
-        self.queue = queue if queue is not None else WorkQueue(name)
+        if queue is None:
+            queue = make_queue(name, tenant_of) if tenant_of else make_queue(name)
+        self.queue = queue
         self.process = process
         self.max_retries = max_retries
         self._workers: list[asyncio.Task] = []
@@ -120,11 +131,12 @@ class BatchController(Controller):
         max_retries: int = DEFAULT_RETRIES,
         max_batch: int = 4096,
         batch_window: float = 0.005,
+        tenant_of=None,
     ):
         async def _unused(_: Item) -> None:  # pragma: no cover
             raise NotImplementedError
 
-        super().__init__(name, _unused, queue, max_retries)
+        super().__init__(name, _unused, queue, max_retries, tenant_of=tenant_of)
         self.process_batch = process_batch
         self.max_batch = max_batch
         self.batch_window = batch_window

@@ -80,6 +80,10 @@ class FairWorkQueue:
         lib.wq_done.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
         lib.wq_len.restype = ctypes.c_uint64
         lib.wq_len.argtypes = [ctypes.c_void_p]
+        lib.wq_live.restype = ctypes.c_int
+        lib.wq_live.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.wq_release.restype = ctypes.c_int
+        lib.wq_release.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
         lib._wq_declared = True
 
     # ---------------------------------------------------------- id mapping
@@ -131,6 +135,14 @@ class FairWorkQueue:
         i = self._ids.get(item)
         if i is not None:
             self._lib.wq_forget(self._q, i)
+            self._release(item, i)
+
+    def _release(self, item: Item, i: int) -> None:
+        """Drop the id interning once the queue no longer references the
+        id anywhere — without this, high-churn keys leak the maps."""
+        if self._lib.wq_release(self._q, i):
+            del self._ids[item]
+            del self._items[i]
 
     # ------------------------------------------------------------ consuming
 
@@ -147,6 +159,13 @@ class FairWorkQueue:
             if self._shutdown:
                 return None
             next_due = self._lib.wq_promote(self._q, time.monotonic())
+            # promote may itself have moved a just-due item into the ready
+            # ring; re-check before sleeping or that item is stranded until
+            # the next add() (there may be no further delayed entries to
+            # bound the wait)
+            got = self._pop_ready(1)
+            if got:
+                return got[0]
             self._wakeup.clear()
             try:
                 await asyncio.wait_for(
@@ -180,6 +199,10 @@ class FairWorkQueue:
         i = self._ids.get(item)
         if i is not None:
             self._lib.wq_done(self._q, i)
+            # done() may have re-queued a redo item natively — wake any
+            # getter so it is not stranded until the next add()
+            self._wakeup.set()
+            self._release(item, i)
 
     # ------------------------------------------------------------- control
 

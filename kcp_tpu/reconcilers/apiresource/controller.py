@@ -95,7 +95,12 @@ class NegotiationController:
         # clusterNameAndGVR indexers (reference controller.go:46-50)
         self.import_informer.add_indexer("cluster_gvr", self._cluster_gvr_index)
         self.negotiated_informer.add_indexer("cluster_gvr", self._cluster_gvr_index)
-        self.controller = BatchController("apiresource-negotiation", self._process_batch)
+        self.controller = BatchController(
+            "apiresource-negotiation", self._process_batch,
+            # item = ((obj_type, clusterName, name), action): fairness is
+            # per logical cluster, not per object
+            tenant_of=lambda item: item[0][1],
+        )
         self.import_informer.add_handler(self._make_handler("import"))
         self.negotiated_informer.add_handler(self._make_handler("negotiated"))
         self.crd_informer.add_handler(self._make_handler("crd"))

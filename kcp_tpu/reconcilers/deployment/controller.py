@@ -79,7 +79,12 @@ class DeploymentSplitter:
         self.informer = Informer(client, DEPLOYMENTS)
         self.cluster_informer = Informer(client, CLUSTERS)
         self.informer.add_indexer("owned_by", self._owned_by_index)
-        self.controller = BatchController("deployment-splitter", self._process_batch)
+        self.controller = BatchController(
+            "deployment-splitter", self._process_batch,
+            # item = ("root"|"leaf", (clusterName, ns, name)): fairness is
+            # per logical cluster
+            tenant_of=lambda item: item[1][0],
+        )
         self.informer.add_handler(self._on_event)
         self.cluster_informer.add_handler(self._on_cluster_event)
         self.stats = {"ticks": 0, "splits": 0, "aggregations": 0}

@@ -102,6 +102,7 @@ class Section:
         # seed the mask cache now: row_for stamps every new row with the
         # current mask, so refresh_mask must only fire on real changes
         self._mask: np.ndarray = owner.fused_status_mask().copy()
+        self.released = False
 
     def row_for(self, key) -> int:
         row = self.rows.get(key)
@@ -127,6 +128,7 @@ class Section:
         self.bucket.mark_stale()
 
     def release(self) -> None:
+        self.released = True
         for row in self.rows.values():
             self.bucket.free_row(row)
         self.rows.clear()
@@ -332,6 +334,7 @@ class FusedCore:
         self._flush_task: asyncio.Task | None = None
         self._refs = 0
         self._started = False
+        self._loop = None
 
     # ---------------------------------------------------------- lifecycle
 
@@ -340,13 +343,16 @@ class FusedCore:
         """The process-wide core for the running asyncio loop (tests run
         many loops sequentially; each gets a fresh core)."""
         try:
-            loop_id = id(asyncio.get_running_loop())
+            loop = asyncio.get_running_loop()
         except RuntimeError:
-            loop_id = 0
-        core = cls._instances.get(loop_id)
-        if core is None or core._closed():
+            loop = None
+        core = cls._instances.get(id(loop))
+        # the identity check guards against id() reuse after a dead loop
+        # is garbage-collected: a stale core's tick task died with its loop
+        if core is None or core._closed() or core._loop is not loop:
             core = cls(mesh=mesh)
-            cls._instances[loop_id] = core
+            core._loop = loop
+            cls._instances[id(loop)] = core
         return core
 
     def _closed(self) -> bool:
@@ -367,6 +373,11 @@ class FusedCore:
             self._flush_task = None
         await self._drain_inflight()
         await self.controller.stop()
+        # drop the registry entry so closed cores (and their device-
+        # resident bucket state) do not accumulate across loops
+        for k, v in list(FusedCore._instances.items()):
+            if v is self:
+                del FusedCore._instances[k]
 
     # ------------------------------------------------------------ plumbing
 
@@ -388,10 +399,14 @@ class FusedCore:
     async def _process_batch(self, items: Sequence) -> list:
         # 1. encode touched keys (engines re-read their informer caches);
         #    section=None items are retick markers — their bucket is
-        #    already marked stale and will re-run on this tick
+        #    already marked stale and will re-run on this tick. Items
+        #    whose section was released (engine stop or vocabulary
+        #    migration) are stale: touching them would resurrect rows in
+        #    the old bucket — drop them, the replacement section was
+        #    re-enqueued with the same keys.
         touched: dict[Section, set] = {}
         for _oid, _side, key, section in items:
-            if section is not None:
+            if section is not None and not section.released:
                 touched.setdefault(section, set()).add(key)
         for section, keys in touched.items():
             self._encode_section(section, keys)

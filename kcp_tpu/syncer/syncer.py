@@ -71,7 +71,17 @@ class Syncer:
 
     # observability: aggregate convergence + throughput over engines
     def stats(self) -> dict:
-        ticks = sum(e.tick_count() for e in self.engines)
+        # fused engines sharing a bucket share its tick counter — count
+        # each bucket once, not once per engine
+        ticks, seen = 0, set()
+        for e in self.engines:
+            if e.fused and e._section is not None:
+                b = e._section.bucket
+                if id(b) not in seen:
+                    seen.add(id(b))
+                    ticks += b.stats["ticks"]
+            else:
+                ticks += e.stats["ticks"]
         applied = sum(e.stats["decisions_applied"] for e in self.engines)
         samples = [s for e in self.engines for s in e.convergence_samples]
         samples.sort()

@@ -42,7 +42,8 @@ struct FairQueue {
   std::unordered_map<uint32_t, std::deque<uint64_t>> ready;  // per-tenant FIFO
   std::deque<uint32_t> rr;  // round-robin ring of tenants with ready items
   std::unordered_set<uint32_t> in_rr;
-  std::unordered_set<uint64_t> pending;     // queued or delayed (dedup)
+  std::unordered_set<uint64_t> pending;     // in a ready ring (dedup)
+  std::unordered_set<uint64_t> delayed_ids; // scheduled in the delay heap (dedup)
   std::unordered_set<uint64_t> processing;  // handed out, not yet done
   std::unordered_set<uint64_t> redo;        // re-added while processing
   std::unordered_map<uint64_t, uint32_t> redo_tenant;
@@ -77,7 +78,9 @@ struct FairQueue {
       add(id, tenant);
       return;
     }
-    if (pending.count(id) && !processing.count(id)) return;
+    if (delayed_ids.count(id)) return;  // earliest schedule wins
+    if (pending.count(id)) return;      // already in a ready ring
+    delayed_ids.insert(id);
     delayed.push(Delayed{now + delay, ++seq, id, tenant});
   }
 
@@ -87,6 +90,7 @@ struct FairQueue {
     while (!delayed.empty() && delayed.top().due <= now) {
       Delayed d = delayed.top();
       delayed.pop();
+      delayed_ids.erase(d.id);
       if (processing.count(d.id)) {
         redo.insert(d.id);
         redo_tenant[d.id] = d.tenant;
@@ -98,6 +102,11 @@ struct FairQueue {
     if (delayed.empty()) return -1.0;
     double dt = delayed.top().due - now;
     return dt > 0 ? dt : 0.0;
+  }
+
+  bool live(uint64_t id) const {
+    return pending.count(id) || delayed_ids.count(id) ||
+           processing.count(id) || redo.count(id);
   }
 
   // Fair drain: one item per tenant per round-robin pass.
@@ -181,7 +190,20 @@ void wq_done(void* q, uint64_t id) { static_cast<FairQueue*>(q)->done(id); }
 
 uint64_t wq_len(void* q) {
   auto* fq = static_cast<FairQueue*>(q);
-  return fq->ready_count + fq->delayed.size();
+  return fq->ready_count + fq->delayed_ids.size();
+}
+
+int wq_live(void* q, uint64_t id) {
+  return static_cast<FairQueue*>(q)->live(id) ? 1 : 0;
+}
+
+// Release an id's bookkeeping if it is no longer anywhere in the queue;
+// returns 1 when released (the caller may then drop its interning entry).
+int wq_release(void* q, uint64_t id) {
+  auto* fq = static_cast<FairQueue*>(q);
+  if (fq->live(id)) return 0;
+  fq->retries.erase(id);
+  return 1;
 }
 
 }  // extern "C"

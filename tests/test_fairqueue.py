@@ -171,3 +171,56 @@ def test_make_queue_fallback(monkeypatch):
 
     monkeypatch.setattr(fq, "FairWorkQueue", Boom)
     assert isinstance(fq.make_queue("x"), WorkQueue)
+
+
+class TestControllerFairness:
+    """VERDICT #5: controllers run on the fair queue by default; a
+    flooding tenant cannot starve quiet tenants' latency."""
+
+    def test_batch_controller_defaults_to_fair_queue(self):
+        from kcp_tpu.reconciler.controller import BatchController
+        from kcp_tpu.reconciler.fairqueue import FairWorkQueue
+
+        async def noop(batch):
+            return []
+
+        async def main():
+            c = BatchController("x", noop)
+            assert isinstance(c.queue, FairWorkQueue)
+
+        asyncio.run(main())
+
+    def test_quiet_tenants_not_starved(self):
+        from kcp_tpu.reconciler.controller import BatchController
+
+        NOISY, QUIET_TENANTS = 2000, 8
+        order: list = []
+
+        async def process(batch):
+            order.extend(batch)
+            await asyncio.sleep(0)  # yield so enqueues interleave
+            return []
+
+        async def main():
+            c = BatchController("starve", process, max_batch=32,
+                                batch_window=0.0)
+            # flood first, then the quiet tenants trickle in
+            for i in range(NOISY):
+                c.enqueue(("noisy", i))
+            for t in range(QUIET_TENANTS):
+                c.enqueue((f"quiet-{t}", 0))
+            await c.start()
+            deadline = asyncio.get_event_loop().time() + 10
+            while (sum(1 for it in order if it[0] != "noisy") < QUIET_TENANTS
+                   and asyncio.get_event_loop().time() < deadline):
+                await asyncio.sleep(0.005)
+            await c.stop()
+
+            # every quiet item must land before even 10% of the flood
+            quiet_pos = [i for i, it in enumerate(order) if it[0] != "noisy"]
+            assert len(quiet_pos) == QUIET_TENANTS
+            assert max(quiet_pos) < NOISY * 0.1, (
+                f"quiet tenants drained at positions {quiet_pos} — starved"
+            )
+
+        asyncio.run(main())
