@@ -293,37 +293,19 @@ def suite() -> int:
 # ---------------------------------------------------------------------------
 # Orchestrator: the TPU rides a tunnel that wedges transiently, and a hung
 # in-process backend init cannot be interrupted from within. So the default
-# entry point (1) probes device availability in a short-timeout subprocess
-# with backoff, (2) runs the actual measurement as a watchdogged child, and
+# entry point (1) pins ITSELF to the CPU platform so the parent can never
+# touch the tunnel (the image's sitecustomize imports jax with the TPU
+# platform baked in — a lazy backend init in the parent would race the
+# child for the single tunnel, the known wedge trigger), (2) runs the
+# measurement directly as a watchdogged child — no probe gate: a probe is
+# exactly as likely to wedge as the measurement and only delays it — and
 # (3) always prints exactly one JSON line — a structured failure record if
 # the device never comes up, never a bare traceback.
 # ---------------------------------------------------------------------------
 
-PROBE_TIMEOUT_S = 120
-PROBE_BACKOFFS_S = (10, 20, 40, 60, 90)  # sleeps between failed probes
 CHILD_TIMEOUT_S = 1200
-CHILD_ATTEMPTS = 2
-
-
-def _probe_device() -> tuple[bool, str]:
-    """Check backend init in a throwaway ``bench.py --probe`` subprocess (a
-    wedged tunnel hangs the caller forever; a child can be killed). The
-    child path shares the __main__ platform-override logic."""
-    import os
-    import subprocess
-
-    env = dict(os.environ, KCP_BENCH_CHILD="1")
-    try:
-        r = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--probe"],
-            env=env, capture_output=True, text=True, timeout=PROBE_TIMEOUT_S,
-        )
-    except subprocess.TimeoutExpired:
-        return False, f"device probe hung > {PROBE_TIMEOUT_S}s (tunnel wedged)"
-    if r.returncode != 0:
-        tail = (r.stderr or r.stdout or "").strip().splitlines()
-        return False, tail[-1] if tail else f"probe rc={r.returncode}"
-    return True, r.stdout.strip()
+CHILD_ATTEMPTS = 4
+ATTEMPT_BACKOFFS_S = (45, 90, 180)  # sleeps between failed attempts
 
 
 def _fail_json(stage: str, detail: str, attempts: int, for_suite: bool) -> None:
@@ -346,23 +328,12 @@ def orchestrate(child_args: list[str]) -> int:
     import tempfile
 
     for_suite = "--suite" in child_args
-    probes = 0
-    for backoff in PROBE_BACKOFFS_S + (None,):
-        probes += 1
-        ok, msg = _probe_device()
-        print(f"probe {probes}: {'ok ' if ok else 'FAIL '}{msg}", file=sys.stderr)
-        if ok:
-            break
-        if backoff is None:
-            _fail_json("backend-init", msg, probes, for_suite)
-            return 0  # structured record IS the deliverable; rc 0 so it lands
-        time.sleep(backoff)
-
     env = dict(os.environ, KCP_BENCH_CHILD="1")
     last = ""
     for attempt in range(1, CHILD_ATTEMPTS + 1):
         if attempt > 1:
-            time.sleep(30)
+            time.sleep(ATTEMPT_BACKOFFS_S[min(attempt - 2,
+                                              len(ATTEMPT_BACKOFFS_S) - 1)])
         # child stderr goes to a file: TimeoutExpired.stderr is None with
         # capture_output on this platform, and the stderr tail is the only
         # diagnostic of where a hung child got stuck
@@ -403,7 +374,22 @@ if __name__ == "__main__":
     import os
 
     args = [a for a in sys.argv[1:] if a != "--child"]
+    if "--probe" in args:
+        # manual diagnostic: always run in-process (never through the
+        # orchestrator, whose JSON contract a probe's output would fail)
+        os.environ["KCP_BENCH_CHILD"] = "1"
     if os.environ.get("KCP_BENCH_CHILD") != "1" and "--child" not in sys.argv:
+        # Parent process: pin to CPU BEFORE anything can lazily init a
+        # backend. sitecustomize has already imported jax with the TPU
+        # platform; only the config lever works at this point. The child
+        # (KCP_BENCH_CHILD=1) keeps the real platform — it must be the
+        # ONLY process on the tunnel.
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
         sys.exit(orchestrate(args))
 
     # honor an explicit JAX_PLATFORMS override: the image's sitecustomize
@@ -420,6 +406,9 @@ if __name__ == "__main__":
             print(f"warning: could not force JAX platform {want!r} ({e}); "
                   f"continuing on the baked-in platform", file=sys.stderr)
     if "--probe" in args:
+        # manual diagnostic only (KCP_BENCH_CHILD=1 python bench.py
+        # --probe): quick device-availability check for tunnel debugging;
+        # the orchestrator itself never probes
         import jax
 
         d = jax.devices()

@@ -9,3 +9,24 @@ Each module is runnable with ``python -m kcp_tpu.cli.<name>``:
 - ``crd_puller``           dump cluster APIs as CRD YAML (cmd/crd-puller)
 - ``compat``               CRD schema compat / LCD check (cmd/compat)
 """
+
+import os
+
+
+def apply_platform_env() -> None:
+    """Honor an explicit ``JAX_PLATFORMS`` override from the shell.
+
+    On images whose sitecustomize registers a TPU plugin before user
+    code runs, the env var alone may not take for plain scripts; the
+    config lever is the one that works. Called by each binary's main
+    before any jax-using import so ``JAX_PLATFORMS=cpu python -m
+    kcp_tpu.cli.kcp start`` deterministically stays off the device.
+    """
+    want = os.environ.get("JAX_PLATFORMS", "")
+    if want and want != "axon":
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", want)
+        except Exception:  # noqa: BLE001 — stay on the default platform
+            pass

@@ -42,6 +42,9 @@ def build_parser():
 
 
 def main(argv: list[str] | None = None) -> int:
+    from . import apply_platform_env
+
+    apply_platform_env()
     args = build_parser().parse_args(argv)
     with open(args.existing, encoding="utf-8") as f:
         existing = yaml.safe_load(f)

@@ -32,6 +32,9 @@ def build_parser():
 
 
 def main(argv: list[str] | None = None) -> int:
+    from . import apply_platform_env
+
+    apply_platform_env()
     args = build_parser().parse_args(argv)
     logging.basicConfig(level=logging.INFO)
     client = RestClient(args.server, cluster=args.cluster)

@@ -126,6 +126,9 @@ def snapshot_cmd(args) -> int:
 
 
 def main(argv: list[str] | None = None) -> int:
+    from . import apply_platform_env
+
+    apply_platform_env()
     args = build_parser().parse_args(argv)
     logging.basicConfig(
         level=logging.DEBUG if args.verbosity > 0 else logging.INFO,

@@ -111,6 +111,9 @@ async def run(args) -> None:
 
 
 def main(argv: list[str] | None = None) -> int:
+    from . import apply_platform_env
+
+    apply_platform_env()
     args = build_parser().parse_args(argv)
     logging.basicConfig(level=logging.INFO)
     asyncio.run(run(args))

@@ -89,6 +89,24 @@ class Client:
         served.update(self._store.resources())
         return sorted(served)
 
+    def openapi_v2(self) -> dict | None:
+        """The cluster's ``/openapi/v2`` swagger document (reference:
+        the discovery client's OpenAPISchema fetch,
+        pkg/crdpuller/discovery.go:60-66). Same resolution as the REST
+        handler — attached document, else synthesized from the
+        cluster's CRDs — so a puller sees identical schemas over either
+        transport."""
+        if self._store.openapi_doc is not None:
+            return self._store.openapi_doc
+        from ..apis import crd as crdapi
+        from ..crdpuller.openapi import doc_from_crds
+
+        try:
+            crds, _ = self._store.list(crdapi.CRDS.storage_name, self.cluster)
+        except Exception:  # noqa: BLE001 — no CRDs ⇒ empty document
+            crds = []
+        return doc_from_crds(crds) if crds else None
+
 
 class MultiClusterClient(Client):
     """Wildcard client — list/watch across all tenants, routed writes.

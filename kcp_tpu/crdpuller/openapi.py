@@ -16,8 +16,15 @@ same semantics, no proto dependency:
   (discovery.go:424-426)
 - array merge/list extensions map onto ``x-kubernetes-list-type`` /
   ``x-kubernetes-list-map-keys`` (discovery.go:336-395)
-- typeless/propertyless subtrees become
-  ``x-kubernetes-preserve-unknown-fields`` (VisitArbitrary)
+- typeless/propertyless subtrees become embedded resources
+  (``x-kubernetes-embedded-resource``) with preserve-unknown defaulting
+  to true — a deliberate deviation from VisitArbitrary
+  (discovery.go:325-335), whose exact output is invalid under
+  Kubernetes structural-schema rules and fails the reference's own
+  schemacompat dispatch (schemacompat.go:144-165)
+- inline ``x-kubernetes-int-or-string`` / preserve-unknown extensions
+  pass through, so CRD-derived documents (a kcp serving published CRDs
+  as swagger) round-trip without degradation
 """
 
 from __future__ import annotations
@@ -27,6 +34,8 @@ from typing import Any
 
 REF_PREFIX = "#/definitions/"
 GVK_EXT = "x-kubernetes-group-version-kind"
+INT_OR_STRING = "x-kubernetes-int-or-string"
+PRESERVE_UNKNOWN = "x-kubernetes-preserve-unknown-fields"
 
 # knownSchemas analog (discovery.go:481-569): schemas for meta types that
 # either can't round-trip through swagger (Quantity, IntOrString) or that
@@ -92,6 +101,14 @@ class SwaggerConverter:
         if desc:
             out["description"] = desc
 
+        # int-or-string carried inline (CRD-derived documents — e.g. a
+        # kcp serving its published CRDs as swagger — express it as the
+        # extension, not as a known $ref): pass it through, or the
+        # round-trip would degrade it to an arbitrary subtree
+        if node.get(INT_OR_STRING):
+            out[INT_OR_STRING] = True
+            return out
+
         if "properties" in node:  # Kind
             out["type"] = "object"
             if node.get("required"):
@@ -105,6 +122,7 @@ class SwaggerConverter:
                     props[fname] = self._node(
                         fnode, inherited_desc=fnode.get("description", ""))
             out["properties"] = props
+            self._copy_preserve_unknown(node, out)
             self._list_extensions(node, out)
             return out
 
@@ -123,21 +141,31 @@ class SwaggerConverter:
             out["items"] = item_schema
             return out
 
-        if ntype:  # Primitive
+        if ntype:  # Primitive (incl. propertyless objects)
             out["type"] = ntype
             if node.get("format"):
                 out["format"] = node["format"]
             if node.get("enum"):
                 out["enum"] = list(node["enum"])
+            self._copy_preserve_unknown(node, out)
             return out
 
-        # Arbitrary: no type, no properties, no ref
-        if node.get("x-kubernetes-preserve-unknown-fields") is not None:
-            out["x-kubernetes-preserve-unknown-fields"] = bool(
-                node["x-kubernetes-preserve-unknown-fields"])
-        else:
-            out["x-kubernetes-preserve-unknown-fields"] = True
+        # Arbitrary: no type, no properties, no ref. VisitArbitrary
+        # (discovery.go:325-335) sets embedded-resource and copies
+        # preserve-unknown only when the source extension exists —
+        # but that exact shape is invalid under Kubernetes structural
+        # rules (embedded-resource requires preserve-unknown or
+        # properties) and fails the reference's own schemacompat type
+        # dispatch. Deliberate deviation: preserve-unknown defaults to
+        # true when the source carries no extension.
+        out["x-kubernetes-embedded-resource"] = True
+        out[PRESERVE_UNKNOWN] = bool(node.get(PRESERVE_UNKNOWN, True))
         return out
+
+    @staticmethod
+    def _copy_preserve_unknown(node: dict, out: dict) -> None:
+        if node.get(PRESERVE_UNKNOWN) is not None:
+            out[PRESERVE_UNKNOWN] = bool(node[PRESERVE_UNKNOWN])
 
     def _ref(self, ref: str, inherited_desc: str) -> dict:
         name = ref[len(REF_PREFIX):] if ref.startswith(REF_PREFIX) else ref
@@ -212,3 +240,28 @@ def convert_definition(doc: dict, def_name: str) -> dict:
     caller's fallback chain (known schemas, preserve-unknown) applies.
     """
     return SwaggerConverter(doc, def_name).convert()
+
+
+def doc_from_crds(crds: list[dict]) -> dict:
+    """Synthesize an ``/openapi/v2`` document from CRD objects, one
+    definition per served version, each carrying the GVK extension that
+    :func:`definition_for_gvk` keys on. Used by both the REST handler
+    and the in-process client so the puller sees the same document over
+    either transport (reference analog: the apiserver's served openapi
+    aggregate, consumed at discovery.go:60-66)."""
+    definitions: dict[str, dict] = {}
+    for crd in crds:
+        spec = crd.get("spec") or {}
+        group = spec.get("group", "")
+        kind = (spec.get("names") or {}).get("kind", "")
+        for v in spec.get("versions", []):
+            schema = (v.get("schema") or {}).get("openAPIV3Schema")
+            if not schema or not kind:
+                continue
+            d = copy.deepcopy(schema)
+            d[GVK_EXT] = [{"group": group, "version": v.get("name", ""),
+                           "kind": kind}]
+            definitions[f"{group}.{v.get('name', '')}.{kind}"] = d
+    return {"swagger": "2.0",
+            "info": {"title": "kcp-tpu", "version": "v0.1.0"},
+            "definitions": definitions}

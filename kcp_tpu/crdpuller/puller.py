@@ -21,6 +21,7 @@ from ..apis import crd as crdapi
 from ..apis.scheme import GVR
 from ..client import Client
 from ..utils import errors
+from . import openapi
 
 log = logging.getLogger(__name__)
 
@@ -119,11 +120,12 @@ class SchemaPuller:
         None when the cluster doesn't serve it (reference: PullCRDs,
         discovery.go:85-287)."""
         out: dict[str, dict | None] = {}
+        doc = self._fetch_openapi()  # once per pass (discovery.go:60-66)
         for res in resources:
             gvr = GVR.parse(res)
             crd = self._from_existing_crd(gvr)
             if crd is None:
-                crd = self._synthesize(gvr)
+                crd = self._synthesize(gvr, doc)
             out[res] = crd
         return out
 
@@ -140,13 +142,26 @@ class SchemaPuller:
         crd.pop("status", None)
         return crd
 
-    def _synthesize(self, gvr: GVR) -> dict | None:
-        """Discovery + known schemas -> synthesized CRD
-        (discovery.go:176-287)."""
+    def _synthesize(self, gvr: GVR, doc: dict | None) -> dict | None:
+        """Discovery -> synthesized CRD (discovery.go:176-287).
+
+        Schema source fallback chain: the curated known-schema table
+        (the resource-level ``knownPackages`` analog — curated schemas
+        override whatever discovery serves, as the reference's known
+        tables do, discovery.go:481-569), then the cluster's
+        ``/openapi/v2`` document (SchemaConverter analog,
+        :mod:`.openapi`), then preserve-unknown.
+        """
         info = self.physical.scheme.by_resource(gvr.storage_name)
         if info is None or gvr.storage_name not in self.physical.resources():
             return None
-        schema = KNOWN_SCHEMAS.get(gvr.resource, _OBJECT_PRESERVE)
+        schema = None
+        if gvr.resource in KNOWN_SCHEMAS:
+            schema = copy.deepcopy(KNOWN_SCHEMAS[gvr.resource])
+        if schema is None:
+            schema = self._from_openapi(info, doc)
+        if schema is None:
+            schema = copy.deepcopy(_OBJECT_PRESERVE)
         has_status = "status" in (schema.get("properties") or {})
         return crdapi.new_crd(
             group=info.gvr.group,
@@ -154,6 +169,37 @@ class SchemaPuller:
             plural=info.gvr.resource,
             kind=info.kind,
             scope="Namespaced" if info.namespaced else "Cluster",
-            schema=copy.deepcopy(schema),
+            schema=schema,
             subresources={"status": {}} if has_status else None,
         )
+
+    def _fetch_openapi(self) -> dict | None:
+        """The cluster's swagger document, fetched once per pull pass
+        (the reference loads openapi models once at puller construction,
+        discovery.go:60-66)."""
+        getter = getattr(self.physical, "openapi_v2", None)
+        if getter is None:
+            return None
+        try:
+            return getter()
+        except errors.ApiError:
+            return None
+
+    def _from_openapi(self, info, doc: dict | None) -> dict | None:
+        """Synthesize a structural schema from the swagger document, or
+        None when the document is absent, carries no definition for the
+        GVK, or the definition cannot convert (recursive refs etc. —
+        discovery.go:200-206 skips such types; here the next fallback
+        applies instead)."""
+        if not doc:
+            return None
+        def_name = openapi.definition_for_gvk(
+            doc, info.gvr.group, info.gvr.version, info.kind)
+        if def_name is None:
+            return None
+        try:
+            return openapi.convert_definition(doc, def_name)
+        except openapi.ConversionError as e:
+            log.warning("openapi conversion for %s failed (%s); falling back",
+                        info.gvr.storage_name, e)
+            return None

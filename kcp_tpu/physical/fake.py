@@ -20,6 +20,7 @@ import asyncio
 import logging
 from typing import Callable
 
+from ..apis.scheme import Scheme, default_scheme
 from ..client import Client, Informer
 from ..store.store import LogicalStore
 
@@ -34,6 +35,7 @@ class PhysicalRegistry:
 
     def __init__(self):
         self._fakes: dict[str, LogicalStore] = {}
+        self._schemes: dict[str, Scheme] = {}
         self._factories: dict[str, Callable[[str], Client]] = {}
 
     def register_factory(self, scheme: str, factory: Callable[[str], Client]) -> None:
@@ -50,7 +52,12 @@ class PhysicalRegistry:
             if store is None:
                 store = LogicalStore()
                 self._fakes[name] = store
-            return Client(store, PHYSICAL_CLUSTER_NAME)
+                self._schemes[name] = default_scheme()
+            # every client resolved for one fake shares one scheme: a
+            # physical cluster has ONE API surface, so a type a test
+            # registers (e.g. a custom resource the importer should
+            # discover) is visible to the controllers' clients too
+            return Client(store, PHYSICAL_CLUSTER_NAME, self._schemes[name])
         scheme = kubeconfig.split("://", 1)[0] if "://" in kubeconfig else ""
         factory = self._factories.get(scheme)
         if factory is None:

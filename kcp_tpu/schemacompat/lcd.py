@@ -33,6 +33,7 @@ from typing import Any
 
 INT_OR_STRING = "x-kubernetes-int-or-string"
 PRESERVE_UNKNOWN = "x-kubernetes-preserve-unknown-fields"
+EMBEDDED = "x-kubernetes-embedded-resource"
 
 
 class CompatError(Exception):
@@ -135,6 +136,22 @@ def _lcd_for_structural(path: str, existing: dict | None, new: dict | None, lcd:
             _lcd_for_int_or_string(path, existing, new, lcd, narrow, errors)
         elif existing.get(PRESERVE_UNKNOWN):
             _check_same_type(path, existing, new, errors)
+        elif existing.get(EMBEDDED):
+            # Deliberate deviation: the reference's type dispatch
+            # (schemacompat.go:144-165) has no case for a typeless
+            # arbitrary node carrying only x-kubernetes-embedded-resource
+            # — yet its own puller emits exactly that shape
+            # (VisitArbitrary, discovery.go:325-335), so an imported
+            # schema with an arbitrary subtree would fail LCD against an
+            # identical copy of itself. Treat it like preserve-unknown:
+            # compatible iff the new node is the same arbitrary shape.
+            if bool(existing.get(EMBEDDED)) != bool(new.get(EMBEDDED)):
+                _err(errors, f"{path}.{EMBEDDED}",
+                     f"{EMBEDDED} value changed (was "
+                     f"{bool(existing.get(EMBEDDED))}, "
+                     f"now {bool(new.get(EMBEDDED))})")
+            else:
+                _check_same_type(path, existing, new, errors)
         else:
             _err(errors, f"{path}.type", f'Invalid type: "{t}"')
     else:

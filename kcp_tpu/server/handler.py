@@ -91,6 +91,20 @@ class RestHandler:
             return await self._route_group(req, cluster, group="", segs=segs[1:])
         if head == "apis":
             return await self._route_apis(req, cluster, segs[1:])
+        if head == "openapi" and segs[1:] == ["v2"]:
+            # the document discloses the cluster's CRD schemas — gate it
+            # exactly like listing CRDs in that cluster
+            if self.authorizer is not None:
+                user = self.authenticator.user_for(req.headers)
+                if not self.authorizer.allowed(
+                        user, cluster, "list", "apiextensions.k8s.io",
+                        "customresourcedefinitions"):
+                    return Response.of_json(
+                        _status_body(403, "Forbidden",
+                                     f'user "{user}" cannot read the openapi '
+                                     f'document of logical cluster "{cluster}"'),
+                        403)
+            return Response.of_json(self._openapi_v2(cluster))
         return _error_response(errors.NotFoundError(f"unknown path {req.path}"))
 
     async def _route_apis(self, req: Request, cluster: str, segs: list[str]):
@@ -161,6 +175,22 @@ class RestHandler:
             return await self._serve_resource(req, cluster, info, namespace, name, subresource)
         except errors.ApiError as e:
             return _error_response(e)
+
+    def _openapi_v2(self, cluster: str) -> dict:
+        """Serve the cluster's swagger document: an attached
+        ``store.openapi_doc`` wins (the fake physical cluster's discovery
+        fixture); otherwise it is synthesized from the cluster's CRDs
+        (:func:`kcp_tpu.crdpuller.openapi.doc_from_crds`)."""
+        from ..apis import crd as crdapi
+        from ..crdpuller.openapi import doc_from_crds
+
+        if self.store.openapi_doc is not None:
+            return self.store.openapi_doc
+        try:
+            crds, _ = self.store.list(crdapi.CRDS.storage_name, cluster)
+        except errors.ApiError:
+            crds = []
+        return doc_from_crds(crds)
 
     def _resolve(self, group: str, version: str, resource: str) -> ResourceInfo | None:
         info = self.scheme.by_resource(GVR(group, version, resource).storage_name)

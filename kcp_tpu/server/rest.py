@@ -409,6 +409,14 @@ class RestClient:
         return sorted(set(self._discovered) |
                       {i.gvr.storage_name for i in self.scheme.all()})
 
+    def openapi_v2(self) -> dict | None:
+        """Fetch the server's ``/openapi/v2`` document (None on 404)."""
+        try:
+            return self._request(
+                "GET", f"/clusters/{quote(self.cluster, safe='*')}/openapi/v2")
+        except errors.NotFoundError:
+            return None
+
 
 class MultiClusterRestClient(RestClient):
     """Wildcard RestClient (EnableMultiCluster analog over the wire)."""

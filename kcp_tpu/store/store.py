@@ -264,6 +264,12 @@ class LogicalStore:
         or their namespaces can never finish deleting.
         """
         self.namespace_lifecycle = namespace_lifecycle
+        # Attachable /openapi/v2 (swagger) document for this store's
+        # API surface — the discovery metadata the CRD puller's schema
+        # synthesis consumes (reference: kube-openapi models fed into
+        # SchemaConverter, pkg/crdpuller/discovery.go:190-207). Not
+        # persisted: it is serving metadata, not state.
+        self.openapi_doc: dict | None = None
         self._objects: dict[Key, dict] = {}
         self._rv = 0
         self._watches: list[Watch] = []

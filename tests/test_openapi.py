@@ -1,0 +1,438 @@
+"""OpenAPI v2 -> CRD schema synthesis, wired end to end.
+
+Covers the reference's SchemaConverter + PullCRDs openapi path
+(pkg/crdpuller/discovery.go:190-207, 289-475): swagger conversion
+semantics, the puller's fallback chain, the served ``/openapi/v2``
+surface, and an e2e import of a type absent from KNOWN_SCHEMAS that
+negotiates a real (non preserve-unknown) schema.
+"""
+
+import asyncio
+
+import pytest
+
+from kcp_tpu.apis import apiresource as ar
+from kcp_tpu.apis import cluster as clusterapi
+from kcp_tpu.apis.scheme import GVR, ResourceInfo
+from kcp_tpu.client import Client, MultiClusterClient
+from kcp_tpu.crdpuller import SchemaPuller
+from kcp_tpu.crdpuller.openapi import (
+    ConversionError,
+    SwaggerConverter,
+    convert_definition,
+    definition_for_gvk,
+)
+from kcp_tpu.physical import PhysicalRegistry
+from kcp_tpu.reconcilers.apiresource import NegotiationController
+from kcp_tpu.reconcilers.cluster import ClusterController, SyncerMode
+from kcp_tpu.reconcilers.crdlifecycle import CRDLifecycleController
+from kcp_tpu.store import LogicalStore
+
+
+def widget_doc():
+    """A swagger document for Widget (example.dev/v1), exercising refs,
+    known meta-type overrides, array merge extensions, maps, enums, and
+    an arbitrary subtree."""
+    return {
+        "swagger": "2.0",
+        "definitions": {
+            "dev.example.v1.Widget": {
+                "description": "Widget is a test resource.",
+                "type": "object",
+                "required": ["spec"],
+                "properties": {
+                    "apiVersion": {"type": "string"},
+                    "kind": {"type": "string"},
+                    "metadata": {
+                        "$ref": "#/definitions/io.k8s.apimachinery.pkg.apis.meta.v1.ObjectMeta"},
+                    "spec": {"$ref": "#/definitions/dev.example.v1.WidgetSpec"},
+                    "status": {"$ref": "#/definitions/dev.example.v1.WidgetStatus"},
+                },
+                "x-kubernetes-group-version-kind": [
+                    {"group": "example.dev", "version": "v1", "kind": "Widget"}],
+            },
+            "dev.example.v1.WidgetSpec": {
+                "description": "spec holds desired state",
+                "type": "object",
+                "properties": {
+                    "size": {"$ref": "#/definitions/io.k8s.apimachinery.pkg.api.resource.Quantity"},
+                    "mode": {"type": "string", "enum": ["auto", "manual"]},
+                    "weight": {"type": "integer", "format": "int32"},
+                    "labels": {"type": "object",
+                               "additionalProperties": {"type": "string"}},
+                    "ports": {
+                        "type": "array",
+                        "items": {"$ref": "#/definitions/dev.example.v1.WidgetPort"},
+                        "x-kubernetes-patch-strategy": "merge",
+                        "x-kubernetes-patch-merge-key": "name",
+                    },
+                    "raw": {},
+                },
+            },
+            "dev.example.v1.WidgetPort": {
+                "type": "object",
+                "properties": {"name": {"type": "string"},
+                               "port": {"type": "integer"}},
+            },
+            "dev.example.v1.WidgetStatus": {
+                "type": "object",
+                "properties": {"ready": {"type": "boolean"},
+                               "updatedAt": {"$ref": "#/definitions/io.k8s.apimachinery.pkg.apis.meta.v1.Time"}},
+            },
+            "io.k8s.apimachinery.pkg.apis.meta.v1.ObjectMeta": {
+                "type": "object", "properties": {"name": {"type": "string"}}},
+            "io.k8s.apimachinery.pkg.apis.meta.v1.Time": {
+                "type": "string", "format": "date-time"},
+            "io.k8s.apimachinery.pkg.api.resource.Quantity": {"type": "string"},
+        },
+    }
+
+
+def register_widgets(client: Client) -> None:
+    client.scheme.register(ResourceInfo(
+        gvr=GVR("example.dev", "v1", "widgets"), kind="Widget",
+        list_kind="WidgetList", singular="widget", namespaced=True))
+
+
+# --------------------------------------------------------------- conversion
+
+
+def test_definition_for_gvk():
+    doc = widget_doc()
+    assert definition_for_gvk(doc, "example.dev", "v1", "Widget") == \
+        "dev.example.v1.Widget"
+    assert definition_for_gvk(doc, "example.dev", "v2", "Widget") is None
+    assert definition_for_gvk(doc, "", "v1", "Widget") is None
+
+
+def test_convert_widget_schema():
+    schema = convert_definition(widget_doc(), "dev.example.v1.Widget")
+    assert schema["type"] == "object"
+    assert schema["description"] == "Widget is a test resource."
+    assert schema["required"] == ["spec"]
+    props = schema["properties"]
+    # root metadata collapses to a bare object (discovery.go:424-426)
+    assert props["metadata"] == {"type": "object"}
+    spec = props["spec"]
+    assert spec["type"] == "object"
+    assert spec["description"] == "spec holds desired state"
+    # known meta-type overrides by suffix
+    assert spec["properties"]["size"] == {"x-kubernetes-int-or-string": True}
+    assert props["status"]["properties"]["updatedAt"] == {
+        "type": "string", "format": "date-time"}
+    # primitives with enum/format
+    assert spec["properties"]["mode"]["enum"] == ["auto", "manual"]
+    assert spec["properties"]["weight"] == {"type": "integer", "format": "int32"}
+    # maps
+    assert spec["properties"]["labels"]["additionalProperties"] == {"type": "string"}
+    # array merge extensions -> list-type map + required keys on items
+    ports = spec["properties"]["ports"]
+    assert ports["x-kubernetes-list-type"] == "map"
+    assert ports["x-kubernetes-list-map-keys"] == ["name"]
+    assert ports["items"]["required"] == ["name"]
+    # arbitrary subtree: embedded-resource set; preserve-unknown defaults
+    # true (documented deviation — the reference's bare shape is invalid
+    # under structural rules and fails its own schemacompat)
+    assert spec["properties"]["raw"] == {
+        "x-kubernetes-embedded-resource": True,
+        "x-kubernetes-preserve-unknown-fields": True,
+    }
+
+
+def test_arbitrary_copies_preserve_unknown_extension():
+    doc = {"definitions": {"D": {
+        "type": "object",
+        "properties": {"x": {"x-kubernetes-preserve-unknown-fields": False},
+                       "y": {"x-kubernetes-preserve-unknown-fields": True}},
+    }}}
+    schema = convert_definition(doc, "D")
+    # an explicit source extension is honored, not overridden
+    assert schema["properties"]["x"] == {
+        "x-kubernetes-embedded-resource": True,
+        "x-kubernetes-preserve-unknown-fields": False,
+    }
+    assert schema["properties"]["y"] == {
+        "x-kubernetes-embedded-resource": True,
+        "x-kubernetes-preserve-unknown-fields": True,
+    }
+
+
+def test_crd_roundtrip_preserves_k8s_extensions():
+    """CRD -> doc_from_crds -> convert_definition keeps preserve-unknown
+    and int-or-string intact, so schemas survive a kcp-to-kcp pull."""
+    from kcp_tpu.crdpuller.openapi import doc_from_crds
+
+    schema = {
+        "type": "object",
+        "properties": {
+            "spec": {"type": "object",
+                     "x-kubernetes-preserve-unknown-fields": True},
+            "port": {"x-kubernetes-int-or-string": True},
+        },
+    }
+    crd = {"spec": {"group": "example.dev",
+                    "names": {"kind": "Widget", "plural": "widgets"},
+                    "versions": [{"name": "v1",
+                                  "schema": {"openAPIV3Schema": schema}}]}}
+    doc = doc_from_crds([crd])
+    name = definition_for_gvk(doc, "example.dev", "v1", "Widget")
+    out = convert_definition(doc, name)
+    assert out["properties"]["spec"] == {
+        "type": "object", "x-kubernetes-preserve-unknown-fields": True}
+    assert out["properties"]["port"] == {"x-kubernetes-int-or-string": True}
+    # and the round-tripped schema is LCD-compatible with the original
+    from kcp_tpu.schemacompat import ensure_structural_schema_compatibility
+
+    _, errs = ensure_structural_schema_compatibility(schema, out)
+    assert errs == []
+
+
+def test_known_schemas_take_precedence_over_openapi():
+    """Curated KNOWN_SCHEMAS override whatever the swagger doc serves
+    (the resource-level knownPackages analog, discovery.go:481-569)."""
+    from kcp_tpu.crdpuller.puller import KNOWN_SCHEMAS
+
+    registry = PhysicalRegistry()
+    phys = registry.resolve("fake://east")
+    registry.fake_store("east").openapi_doc = {"definitions": {
+        "io.k8s.api.apps.v1.Deployment": {
+            "type": "object", "properties": {"bogus": {"type": "string"}},
+            "x-kubernetes-group-version-kind": [
+                {"group": "apps", "version": "v1", "kind": "Deployment"}],
+        },
+    }}
+    crd = SchemaPuller(phys).pull_crds(["deployments.apps"])["deployments.apps"]
+    schema = crd["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
+    assert schema == KNOWN_SCHEMAS["deployments"]
+
+
+def test_recursive_ref_is_conversion_error():
+    doc = {"definitions": {
+        "A": {"type": "object", "properties": {"b": {"$ref": "#/definitions/B"}}},
+        "B": {"type": "object", "properties": {"a": {"$ref": "#/definitions/A"}}},
+    }}
+    with pytest.raises(ConversionError, match="recursive"):
+        convert_definition(doc, "A")
+
+
+def test_missing_definition_and_unresolved_ref():
+    with pytest.raises(ConversionError, match="not found"):
+        convert_definition({"definitions": {}}, "Nope")
+    doc = {"definitions": {"A": {"$ref": "#/definitions/Gone"}}}
+    with pytest.raises(ConversionError, match="unresolved"):
+        SwaggerConverter(doc, "A").convert()
+
+
+# ------------------------------------------------------------------ puller
+
+
+def test_puller_synthesizes_from_openapi():
+    registry = PhysicalRegistry()
+    phys = registry.resolve("fake://east")
+    register_widgets(phys)
+    registry.fake_store("east").openapi_doc = widget_doc()
+
+    crds = SchemaPuller(phys).pull_crds(["widgets.example.dev"])
+    crd = crds["widgets.example.dev"]
+    assert crd is not None
+    version = crd["spec"]["versions"][0]
+    schema = version["schema"]["openAPIV3Schema"]
+    assert "x-kubernetes-preserve-unknown-fields" not in schema
+    assert schema["properties"]["spec"]["properties"]["mode"]["enum"] == \
+        ["auto", "manual"]
+    # status in properties -> status subresource (discovery.go:214-224
+    # derives it from discovery; ours from the schema shape)
+    assert "status" in version["subresources"]
+
+
+def test_puller_falls_back_without_definition():
+    """Doc present but no matching GVK -> KNOWN_SCHEMAS/preserve-unknown."""
+    registry = PhysicalRegistry()
+    phys = registry.resolve("fake://east")
+    register_widgets(phys)
+    registry.fake_store("east").openapi_doc = {"definitions": {}}
+
+    crd = SchemaPuller(phys).pull_crds(["widgets.example.dev"])["widgets.example.dev"]
+    schema = crd["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
+    assert schema.get("x-kubernetes-preserve-unknown-fields") is True
+
+
+def test_puller_falls_back_on_recursive_schema():
+    registry = PhysicalRegistry()
+    phys = registry.resolve("fake://east")
+    register_widgets(phys)
+    registry.fake_store("east").openapi_doc = {"definitions": {
+        "dev.example.v1.Widget": {
+            "type": "object",
+            "properties": {"self": {"$ref": "#/definitions/dev.example.v1.Widget"}},
+            "x-kubernetes-group-version-kind": [
+                {"group": "example.dev", "version": "v1", "kind": "Widget"}],
+        },
+    }}
+    crd = SchemaPuller(phys).pull_crds(["widgets.example.dev"])["widgets.example.dev"]
+    schema = crd["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
+    assert schema.get("x-kubernetes-preserve-unknown-fields") is True
+
+
+# ------------------------------------------------------------ REST surface
+
+
+def test_rest_serves_openapi_from_published_crds():
+    """A kcp server synthesizes /openapi/v2 from its CRDs, and the
+    RestClient round-trips it into a puller-consumable document."""
+    from kcp_tpu.apis import crd as crdapi
+    from kcp_tpu.server import Config, RestClient
+    from kcp_tpu.server.threaded import ServerThread
+
+    with ServerThread(Config(durable=False, install_controllers=False)) as st:
+        port = st.server.http.port
+        rc = RestClient(f"http://127.0.0.1:{port}", "admin")
+        rc.create(crdapi.CRDS, crdapi.new_crd(
+            group="example.dev", version="v1", plural="widgets",
+            kind="Widget", schema={
+                "type": "object",
+                "properties": {"spec": {"type": "object", "properties": {
+                    "mode": {"type": "string"}}}},
+            }))
+        doc = rc.openapi_v2()
+        name = definition_for_gvk(doc, "example.dev", "v1", "Widget")
+        assert name == "example.dev.v1.Widget"
+        schema = convert_definition(doc, name)
+        assert schema["properties"]["spec"]["properties"]["mode"] == {
+            "type": "string"}
+        rc.close()
+
+
+def test_openapi_route_enforces_authz():
+    """/openapi/v2 discloses CRD schemas — it is gated like listing CRDs
+    (anonymous: 403; admin token: 200)."""
+    from kcp_tpu.apis.scheme import default_scheme
+    from kcp_tpu.server.authz import Authenticator, Authorizer
+    from kcp_tpu.server.handler import RestHandler
+    from kcp_tpu.server.httpd import Request
+
+    async def main():
+        store = LogicalStore()
+        authn = Authenticator(tokens={"admin-tok": "admin"})
+        handler = RestHandler(store, default_scheme(),
+                              authenticator=authn, authorizer=Authorizer(store))
+        anon = Request(method="GET", path="/clusters/team-a/openapi/v2",
+                       query={}, headers={}, body=b"")
+        resp = await handler(anon)
+        assert resp.status == 403
+        admin = Request(method="GET", path="/clusters/team-a/openapi/v2",
+                        query={}, headers={"authorization": "Bearer admin-tok"},
+                        body=b"")
+        resp = await handler(admin)
+        assert resp.status == 200
+
+    asyncio.run(main())
+
+
+def test_lcd_accepts_arbitrary_embedded_subtree():
+    """An imported schema with an arbitrary (embedded-resource, typeless)
+    subtree must be LCD-compatible with an identical copy of itself —
+    the renegotiation path every later import of the same type hits
+    (documented deviation from schemacompat.go:144-165)."""
+    from kcp_tpu.schemacompat import ensure_structural_schema_compatibility
+
+    s = convert_definition(widget_doc(), "dev.example.v1.Widget")
+    lcd, errs = ensure_structural_schema_compatibility(s, s)
+    assert errs == []
+    assert lcd == s
+    # and an arbitrary node vs a typed node still fails
+    a = {"type": "object", "properties": {"raw": {
+        "x-kubernetes-embedded-resource": True}}}
+    b = {"type": "object", "properties": {"raw": {"type": "string"}}}
+    _, errs = ensure_structural_schema_compatibility(a, b)
+    assert errs
+
+
+# -------------------------------------------------------------------- e2e
+
+
+def test_import_unknown_type_through_openapi_e2e():
+    """A type absent from KNOWN_SCHEMAS imports with a REAL schema: fake
+    physical cluster serves /openapi/v2 -> APIImporter -> APIResourceImport
+    -> negotiation -> published NegotiatedAPIResource + CRD, schema intact
+    (reference flow: discovery.go:176-287 into negotiation.go:39-175)."""
+
+    async def main():
+        store = LogicalStore()
+        mc = MultiClusterClient(store)
+        registry = PhysicalRegistry()
+
+        phys = registry.resolve("fake://east")
+        register_widgets(phys)
+        registry.fake_store("east").openapi_doc = widget_doc()
+
+        negc = NegotiationController(mc, auto_publish=True)
+        lifecycle = CRDLifecycleController(mc)
+        clusterc = ClusterController(
+            mc, registry, resources_to_sync=["widgets.example.dev"],
+            mode=SyncerMode.NONE,
+            poll_interval=0.2, import_poll_interval=0.2,
+        )
+        await negc.start()
+        await lifecycle.start()
+        await clusterc.start()
+        try:
+            t = mc.cluster_client("org-widgets")
+            t.create(clusterapi.CLUSTERS, clusterapi.new_cluster(
+                "east", kubeconfig="fake://east"))
+
+            async def eventually(pred, timeout=10.0):
+                loop = asyncio.get_event_loop()
+                end = loop.time() + timeout
+                last = None
+                while loop.time() < end:
+                    try:
+                        last = pred()
+                        if last:
+                            return last
+                    except Exception as e:  # noqa: BLE001
+                        last = repr(e)
+                    await asyncio.sleep(0.02)
+                raise AssertionError(f"not reached (last={last!r})")
+
+            def import_has_real_schema():
+                items, _ = t.list(ar.APIRESOURCEIMPORTS)
+                for obj in items:
+                    if obj["spec"]["plural"] == "widgets":
+                        import json
+
+                        schema = json.loads(obj["spec"]["openAPIV3Schema"]) \
+                            if isinstance(obj["spec"]["openAPIV3Schema"], str) \
+                            else obj["spec"]["openAPIV3Schema"]
+                        assert "x-kubernetes-preserve-unknown-fields" not in schema
+                        return schema
+                return None
+
+            schema = await eventually(import_has_real_schema)
+            assert schema["properties"]["spec"]["properties"]["mode"]["enum"] == \
+                ["auto", "manual"]
+
+            def negotiated_published():
+                items, _ = t.list(ar.NEGOTIATEDAPIRESOURCES)
+                for obj in items:
+                    if obj["spec"]["plural"] == "widgets":
+                        for c in (obj.get("status") or {}).get("conditions", []):
+                            if c["type"] == "Published" and c["status"] == "True":
+                                return obj
+                return None
+
+            negotiated = await eventually(negotiated_published)
+            nschema = negotiated["spec"]["openAPIV3Schema"]
+            if isinstance(nschema, str):
+                import json
+
+                nschema = json.loads(nschema)
+            assert "x-kubernetes-preserve-unknown-fields" not in nschema
+            assert nschema["properties"]["spec"]["properties"]["weight"] == {
+                "type": "integer", "format": "int32"}
+        finally:
+            await clusterc.stop()
+            await lifecycle.stop()
+            await negc.stop()
+
+    asyncio.run(main())
