@@ -85,6 +85,7 @@ INIT_STALL_S = 110  # device init not done by then => report + exit early
 CHILD_ATTEMPTS = 3
 ATTEMPT_BACKOFFS_S = (20, 30)
 DEADLINE_ENV = "KCP_BENCH_DEADLINE"  # unix time the orchestrator kills at
+FINAL_ATTEMPT_ENV = "KCP_BENCH_FINAL"  # last attempt: init gets the full window
 
 
 def emit(result: dict) -> None:
@@ -244,7 +245,13 @@ class Deadman:
 def main() -> int:
     best: dict = {}
     deadman = Deadman(best)
-    deadman.arm("device-init", INIT_STALL_S)
+    # early attempts cap device init at INIT_STALL_S to keep retry budget;
+    # the FINAL attempt has nothing left to save for, so a legitimately
+    # slow (not hung) init gets the whole remaining window
+    if os.environ.get(FINAL_ATTEMPT_ENV) == "1":
+        deadman.arm("device-init")
+    else:
+        deadman.arm("device-init", INIT_STALL_S)
     print("initializing device...", file=sys.stderr, flush=True)
 
     import jax
@@ -563,6 +570,8 @@ def orchestrate(child_args: list[str]) -> int:
                                               len(ATTEMPT_BACKOFFS_S) - 1)])
         env = dict(os.environ, KCP_BENCH_CHILD="1")
         env[DEADLINE_ENV] = str(time.time() + CHILD_TIMEOUT_S)
+        if attempt == CHILD_ATTEMPTS:
+            env[FINAL_ATTEMPT_ENV] = "1"
         # child stdout AND stderr go to files: TimeoutExpired's captures
         # are None with pipes on this platform, and the salvaged evidence
         # line + stderr tail are the whole point of the harness
@@ -594,7 +603,15 @@ def orchestrate(child_args: list[str]) -> int:
             if not salvaged.get("provisional"):
                 print(json.dumps(salvaged))
                 return 0
-            if best is None or salvaged.get("value", 0) > best.get("value", 0):
+            # completeness metric: suite = lanes measured, else the rate
+            def _merit(obj: dict | None) -> float:
+                if obj is None:
+                    return -1.0
+                if for_suite:
+                    return float(len(obj.get("suite", [])))
+                return float(obj.get("value", 0))
+
+            if _merit(salvaged) > _merit(best):
                 best = salvaged
             last = f"attempt {attempt}: provisional evidence only"
         else:

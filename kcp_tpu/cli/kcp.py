@@ -60,6 +60,11 @@ def build_parser():
                             "minted into admin.kubeconfig")
     start.add_argument("--admin-token", default="",
                        help="fixed admin bearer token (minted when empty)")
+    start.add_argument("--mesh", default="",
+                       help="serving-mesh spec to shard the fused reconcile "
+                            "core over jax devices: N (tenants), NxM "
+                            "(tenants x slots) or NxMxK (hosts x tenants x "
+                            "slots), e.g. --mesh 4x2")
     start.add_argument("-v", "--verbosity", type=int, default=0)
 
     snap = sub.add_parser(
@@ -86,6 +91,7 @@ def config_from_args(args) -> Config:
         import_poll_interval=args.poll_interval,
         authz=args.authz,
         admin_token=args.admin_token,
+        mesh=args.mesh,
     )
 
 

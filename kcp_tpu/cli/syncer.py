@@ -63,6 +63,9 @@ def build_parser(pod_form_only: bool = False):
                    help="sync target id — the kcp.dev/cluster label value "
                         "(reference: -cluster)")
     p.add_argument("--backend", choices=["tpu", "host"], default="tpu")
+    p.add_argument("--mesh", default="",
+                   help="serving-mesh spec (N, NxM or NxMxK) to shard the "
+                        "fused core over jax devices")
     p.add_argument("resources", nargs="+",
                    help="resource types to sync, e.g. deployments.apps")
     return p
@@ -97,8 +100,13 @@ async def run(args) -> None:
             from_server, token = kubeconfig_credentials(f.read())
     upstream = RestClient(from_server, cluster=args.from_cluster, token=token)
     downstream = RestClient(args.to_server, cluster=args.to_cluster)
+    mesh = None
+    if getattr(args, "mesh", ""):
+        from ..parallel.mesh import mesh_from_spec
+
+        mesh = mesh_from_spec(args.mesh)
     syncer = await start_syncer(upstream, downstream, args.resources,
-                                args.cluster, backend=args.backend)
+                                args.cluster, backend=args.backend, mesh=mesh)
     stop = asyncio.Event()
     loop = asyncio.get_event_loop()
     for sig in (signal.SIGINT, signal.SIGTERM):

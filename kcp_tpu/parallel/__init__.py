@@ -1,7 +1,12 @@
 from .mesh import (
+    HOSTS_AXIS,
     TENANTS_AXIS,
     SLOTS_AXIS,
+    get_serving_mesh,
     make_mesh,
+    make_multihost_mesh,
+    mesh_from_spec,
+    set_serving_mesh,
     shard_state,
     state_sharding_tree,
     state_shardings,
@@ -9,9 +14,14 @@ from .mesh import (
 
 __all__ = [
     "make_mesh",
+    "make_multihost_mesh",
+    "mesh_from_spec",
+    "get_serving_mesh",
+    "set_serving_mesh",
     "state_shardings",
     "state_sharding_tree",
     "shard_state",
+    "HOSTS_AXIS",
     "TENANTS_AXIS",
     "SLOTS_AXIS",
 ]

@@ -40,6 +40,48 @@ HOSTS_AXIS = "hosts"
 TENANTS_AXIS = "tenants"
 SLOTS_AXIS = "slots"
 
+# the process-wide serving mesh: set once at startup (server.Config.mesh
+# / --mesh), read by FusedCore.for_current_loop when no explicit mesh is
+# threaded through — so every sync engine in the process serves sharded
+# without each call site re-plumbing it
+_SERVING_MESH: Mesh | None = None
+
+
+def set_serving_mesh(mesh: "Mesh | str | None") -> Mesh | None:
+    """Install the process-default serving mesh (a Mesh or a spec string
+    like ``"8"``, ``"4x2"``, ``"2x2x2"``). None clears it."""
+    global _SERVING_MESH
+    _SERVING_MESH = mesh_from_spec(mesh) if isinstance(mesh, str) else mesh
+    return _SERVING_MESH
+
+
+def get_serving_mesh() -> Mesh | None:
+    return _SERVING_MESH
+
+
+def mesh_from_spec(spec: str, devices: list | None = None) -> Mesh:
+    """Build a mesh from a CLI/config spec string.
+
+    ``"8"`` -> (tenants=8,); ``"4x2"`` -> (tenants=4, slots=2);
+    ``"2x2x2"`` -> (hosts=2, tenants=2, slots=2). The flat device count
+    must be available.
+    """
+    dims = [int(d) for d in spec.lower().replace("*", "x").split("x") if d]
+    if not dims or any(d < 1 for d in dims) or len(dims) > 3:
+        raise ValueError(f"bad mesh spec {spec!r}: want N, NxM or NxMxK")
+    if len(dims) == 1:
+        return make_mesh(n_devices=dims[0], slots=1, devices=devices)
+    if len(dims) == 2:
+        return make_mesh(n_devices=dims[0] * dims[1], tenants=dims[0],
+                         slots=dims[1], devices=devices)
+    h, t, s = dims
+    devs = devices if devices is not None else jax.devices()
+    n = h * t * s
+    if len(devs) < n:
+        raise ValueError(f"mesh spec {spec!r} needs {n} devices, "
+                         f"have {len(devs)}")
+    return make_multihost_mesh(hosts=h, tenants=t, slots=s, devices=devs[:n])
+
 
 def make_mesh(
     n_devices: int | None = None,

@@ -30,10 +30,11 @@ class PodSpecError(Exception):
     """The installed manifests do not form a runnable syncer pod."""
 
 
-def parse_installed_syncer(physical: Client) -> tuple[str, str, list[str]]:
-    """Read back (kcp_kubeconfig, cluster_name, resources) from the
-    installed Deployment + ConfigMap, the way the container would see
-    them (kubeconfig via the volume mount, the rest via args)."""
+def parse_installed_syncer(physical: Client) -> tuple[str, str, list[str], str]:
+    """Read back (kcp_kubeconfig, cluster_name, resources, mesh_spec)
+    from the installed Deployment + ConfigMap, the way the container
+    would see them (kubeconfig via the volume mount, the rest via
+    args)."""
     try:
         dep = physical.get("deployments.apps", SYNCER_NAME, SYNCER_NAMESPACE)
         cm = physical.get("configmaps", f"{SYNCER_NAME}-kubeconfig", SYNCER_NAMESPACE)
@@ -63,7 +64,7 @@ def parse_installed_syncer(physical: Client) -> tuple[str, str, list[str]]:
         ) from err
     if not ns.from_kubeconfig:
         raise PodSpecError("no -from_kubeconfig arg in syncer Deployment")
-    return kubeconfig, ns.cluster, list(ns.resources)
+    return kubeconfig, ns.cluster, list(ns.resources), getattr(ns, "mesh", "")
 
 
 async def run_installed_syncer(
@@ -77,10 +78,15 @@ async def run_installed_syncer(
     kcp upstream client (the fake-registry analog of client-go building
     a clientset from /kcp/kubeconfig).
     """
-    kubeconfig, cluster, resources = parse_installed_syncer(physical)
+    kubeconfig, cluster, resources, mesh_spec = parse_installed_syncer(physical)
     upstream = resolve_kubeconfig(kubeconfig)
+    mesh = None
+    if mesh_spec:
+        from ..parallel.mesh import mesh_from_spec
+
+        mesh = mesh_from_spec(mesh_spec)
     # start_syncer, not Syncer: the pod's binary validates the resource
     # set via discovery first (RetryableError while a resource is not
     # served yet), and the emulator must fail the same way
     return await start_syncer(upstream, physical, resources, cluster,
-                              backend=backend)
+                              backend=backend, mesh=mesh)

@@ -59,12 +59,18 @@ class ClusterController:
         import_poll_interval: float | None = None,
         kcp_kubeconfig: str = "",
         syncer_image: str = "kcp-tpu/syncer:latest",
+        mesh=None,
+        mesh_spec: str = "",
     ):
         self.client = client
         self.registry = registry
         self.resources_to_sync = resources_to_sync or ["deployments.apps"]
         self.mode = mode
         self.backend = backend
+        self.mesh = mesh  # sharding for push-mode syncers' fused core
+        # pull mode ships the sharding as a CLI spec in the pod manifest
+        # (a live Mesh object cannot cross the process boundary)
+        self.mesh_spec = mesh_spec
         self.poll_interval = poll_interval
         self.import_poll_interval = (
             import_poll_interval if import_poll_interval is not None else poll_interval
@@ -176,7 +182,8 @@ class ClusterController:
             return
         if self.mode == SyncerMode.PUSH:
             try:
-                syncer = Syncer(scoped, physical, synced, name, backend=self.backend)
+                syncer = Syncer(scoped, physical, synced, name,
+                                backend=self.backend, mesh=self.mesh)
                 await syncer.start()
                 self.syncers[key] = syncer
             except Exception as err:  # noqa: BLE001
@@ -188,7 +195,8 @@ class ClusterController:
         elif self.mode == SyncerMode.PULL:
             try:
                 installer.install_syncer(
-                    physical, name, self.kcp_kubeconfig, synced, self.syncer_image
+                    physical, name, self.kcp_kubeconfig, synced,
+                    self.syncer_image, mesh_spec=self.mesh_spec,
                 )
             except Exception as err:  # noqa: BLE001
                 self._set_status(scoped, cluster, ready=False,

@@ -24,10 +24,13 @@ SYNCER_NAME = "syncer"
 
 
 def syncer_manifests(
-    cluster_name: str, kcp_kubeconfig: str, resources: list[str], image: str
+    cluster_name: str, kcp_kubeconfig: str, resources: list[str], image: str,
+    mesh_spec: str = "",
 ) -> list[tuple[str, dict]]:
     """(gvr, object) pairs to apply, mirroring installSyncer's manifest set
-    (syncer.go:38-227)."""
+    (syncer.go:38-227). ``mesh_spec`` forwards the serving-mesh sharding
+    to the pod's syncer CLI (--mesh) so pull mode shards like push mode."""
+    mesh_args = ["--mesh", mesh_spec] if mesh_spec else []
     return [
         ("namespaces", {
             "apiVersion": "v1", "kind": "Namespace",
@@ -74,7 +77,7 @@ def syncer_manifests(
                             "args": (["-from_kubeconfig",
                                       "/kcp/kubeconfig",
                                       "-cluster", cluster_name]
-                                     + list(resources)),
+                                     + mesh_args + list(resources)),
                             "volumeMounts": [{"name": "kubeconfig", "mountPath": "/kcp"}],
                         }],
                         "volumes": [{"name": "kubeconfig", "configMap": {
@@ -89,8 +92,10 @@ def syncer_manifests(
 def install_syncer(
     physical: Client, cluster_name: str, kcp_kubeconfig: str,
     resources: list[str], image: str = "kcp-tpu/syncer:latest",
+    mesh_spec: str = "",
 ) -> None:
-    for gvr, obj in syncer_manifests(cluster_name, kcp_kubeconfig, resources, image):
+    for gvr, obj in syncer_manifests(cluster_name, kcp_kubeconfig, resources,
+                                     image, mesh_spec):
         ns = obj["metadata"].get("namespace", "")
         try:
             physical.create(gvr, obj, namespace=ns)

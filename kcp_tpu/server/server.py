@@ -42,6 +42,10 @@ class Config:
     authz: bool = False  # RBAC-lite enforcement (server/authz.py); the
     # reference prototype runs open, so open stays the default
     admin_token: str = ""  # minted when empty and authz is on
+    mesh: str = ""  # serving-mesh spec ("8", "4x2", "2x2x2"): shard the
+    # fused reconcile core's buckets over a jax device mesh (SURVEY §7.2
+    # step 9; the reference's horizontal-sharding story,
+    # docs/investigations/logical-clusters.md:83)
 
 
 class Server:
@@ -125,6 +129,13 @@ class Server:
 
         mode = {"push": SyncerMode.PUSH, "pull": SyncerMode.PULL,
                 "none": SyncerMode.NONE}[self.config.syncer_mode]
+        mesh = None
+        if self.config.mesh:
+            from ..parallel.mesh import set_serving_mesh
+
+            mesh = set_serving_mesh(self.config.mesh)
+            log.info("serving mesh: %s",
+                     dict(zip(mesh.axis_names, mesh.devices.shape)))
         self._controllers = [
             NegotiationController(self.client,
                                   auto_publish=self.config.auto_publish_apis),
@@ -134,6 +145,7 @@ class Server:
                 resources_to_sync=self.config.resources_to_sync,
                 mode=mode, poll_interval=self.config.poll_interval,
                 import_poll_interval=self.config.import_poll_interval,
+                mesh=mesh, mesh_spec=self.config.mesh,
             ),
             DeploymentSplitter(self.client),
             # the reference's "start-namespace-controller" hook

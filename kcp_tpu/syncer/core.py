@@ -142,6 +142,22 @@ class FusedBucket:
         self.S = slots
         self.B = 0
         self.mesh = mesh
+        # sharded state must device_put cleanly: row counts are padded to
+        # a multiple of the row-axis product (see _grow), and the slots
+        # axis must divide the (power-of-two) slot capacity up front
+        self._row_factor = 1
+        if mesh is not None:
+            from ..parallel.mesh import HOSTS_AXIS, SLOTS_AXIS, TENANTS_AXIS
+
+            dims = dict(zip(mesh.axis_names, mesh.devices.shape))
+            self._row_factor = dims.get(HOSTS_AXIS, 1) * dims.get(TENANTS_AXIS, 1)
+            slot_dim = dims.get(SLOTS_AXIS, 1)
+            if slots % slot_dim:
+                raise ValueError(
+                    f"bucket slot capacity {slots} is not divisible by the "
+                    f"mesh slots axis ({slot_dim}); use a power-of-two "
+                    f"slots axis"
+                )
         self.up_vals = np.zeros((0, slots), np.uint32)
         self.down_vals = np.zeros((0, slots), np.uint32)
         self.up_exists = np.zeros(0, bool)
@@ -189,6 +205,10 @@ class FusedBucket:
 
     def _grow(self, needed: int) -> None:
         new_b = pad_pow2(max(needed, MIN_ROWS))
+        if new_b % self._row_factor:
+            # non-power-of-two row sharding (e.g. a 5-device tenants
+            # axis): round up so every row dimension device_puts cleanly
+            new_b += self._row_factor - new_b % self._row_factor
 
         def grow(a, shape, dtype):
             out = np.zeros(shape, dtype)
@@ -230,7 +250,10 @@ class FusedBucket:
     def _device_state(self) -> ReconcileState:
         # minimal splitter/fanout lanes: the sync serving path doesn't use
         # them, but the program IS the flagship step, lanes and all
-        r, p, l, c = 8, 8, 1, 8
+        # (placement rows are row-sharded too — pad to the row factor)
+        f = self._row_factor
+        r = ((8 + f - 1) // f) * f
+        p, l, c = 8, 1, 8
         state = ReconcileState(
             up_vals=self.up_vals, up_exists=self.up_exists,
             down_vals=self.down_vals, down_exists=self.down_exists,
@@ -341,7 +364,16 @@ class FusedCore:
     @classmethod
     def for_current_loop(cls, mesh=None) -> "FusedCore":
         """The process-wide core for the running asyncio loop (tests run
-        many loops sequentially; each gets a fresh core)."""
+        many loops sequentially; each gets a fresh core).
+
+        ``mesh=None`` falls back to the process serving mesh
+        (parallel.mesh.set_serving_mesh — the server's Config.mesh /
+        --mesh flag), so a configured process serves sharded without
+        every engine re-plumbing the mesh."""
+        if mesh is None:
+            from ..parallel.mesh import get_serving_mesh
+
+            mesh = get_serving_mesh()
         try:
             loop = asyncio.get_running_loop()
         except RuntimeError:
@@ -353,6 +385,9 @@ class FusedCore:
             core = cls(mesh=mesh)
             core._loop = loop
             cls._instances[id(loop)] = core
+        elif mesh is not None and core.mesh != mesh:
+            log.warning("FusedCore for this loop already exists with a "
+                        "different mesh; keeping the existing core's mesh")
         return core
 
     def _closed(self) -> bool:
@@ -413,7 +448,15 @@ class FusedCore:
 
         # 2. one fused step per dirty bucket; collection is pipelined
         for bucket in self.buckets.values():
-            wire = bucket.submit()
+            try:
+                wire = bucket.submit()
+            except Exception:
+                # surface loudly: a submit failure (bad sharding, device
+                # error) otherwise dies as 5 silent INFO-level retries
+                log.exception("fused-core: bucket submit failed "
+                              "(B=%d S=%d mesh=%s)", bucket.B, bucket.S,
+                              bucket.mesh is not None)
+                raise
             if wire is not None:
                 self._inflight.append((bucket, wire))
 

@@ -121,6 +121,7 @@ class BatchSyncEngine:
         batch_window: float = 0.002,
         resync_period: float | None = DEFAULT_RESYNC_PERIOD,
         core=None,
+        mesh=None,
         apply_workers: int = 4,
         max_apply_retries: int = 5,
     ):
@@ -131,6 +132,7 @@ class BatchSyncEngine:
         self.backend = backend
         self.fused = backend == "tpu"
         self.core = core
+        self.mesh = mesh  # sharding for the fused core (None = serving default)
         self.namespace_gvr = namespace_gvr
         self.selector: LabelSelector = parse_selector(f"{CLUSTER_LABEL}={cluster_id}")
 
@@ -599,7 +601,7 @@ class BatchSyncEngine:
             if self.core is None:
                 from .core import FusedCore
 
-                self.core = FusedCore.for_current_loop()
+                self.core = FusedCore.for_current_loop(mesh=self.mesh)
             self._section = self.core.register(self, self.enc.capacity)
             await self.core.start()
         # informers after the section exists: their initial list replays

@@ -49,11 +49,13 @@ class Syncer:
         resources: list[str],
         cluster_id: str,
         backend: str = "tpu",
+        mesh=None,
     ):
         self.cluster_id = cluster_id
         self.resources = list(resources)
         self.engines = [
-            BatchSyncEngine(upstream, downstream, gvr, cluster_id, backend=backend)
+            BatchSyncEngine(upstream, downstream, gvr, cluster_id,
+                            backend=backend, mesh=mesh)
             for gvr in resources
         ]
         self._started = False
@@ -100,13 +102,17 @@ async def start_syncer(
     resources: list[str],
     cluster_id: str,
     backend: str = "tpu",
+    mesh=None,
 ) -> Syncer:
     """Push-mode entry point (reference: StartSyncer, syncer.go:46-64).
 
     Validates the resource set via discovery first (retryable while the
-    upstream does not serve a requested resource yet).
+    upstream does not serve a requested resource yet). ``mesh`` shards
+    the fused serving core's buckets over a device mesh
+    (parallel.mesh.make_mesh); None uses the process serving mesh.
     """
     discover_gvrs(upstream, resources)
-    s = Syncer(upstream, downstream, resources, cluster_id, backend=backend)
+    s = Syncer(upstream, downstream, resources, cluster_id, backend=backend,
+               mesh=mesh)
     await s.start()
     return s

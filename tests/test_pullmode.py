@@ -36,10 +36,22 @@ def test_parse_installed_syncer_roundtrip():
     phys = Client(LogicalStore(), "pcluster")
     installer.install_syncer(phys, "east", "kcp://test-kubeconfig",
                              ["configmaps", "deployments.apps"])
-    kubeconfig, cluster, resources = parse_installed_syncer(phys)
+    kubeconfig, cluster, resources, mesh_spec = parse_installed_syncer(phys)
     assert kubeconfig == "kcp://test-kubeconfig"
     assert cluster == "east"
     assert resources == ["configmaps", "deployments.apps"]
+    assert mesh_spec == ""
+
+
+def test_parse_installed_syncer_forwards_mesh_spec():
+    """kcp --mesh + pull mode: the pod manifest carries --mesh and the
+    pod-form parser hands it back (the sharding crosses the process
+    boundary as a spec string)."""
+    phys = Client(LogicalStore(), "pcluster")
+    installer.install_syncer(phys, "east", "kcp://test-kubeconfig",
+                             ["configmaps"], mesh_spec="4x2")
+    _kc, _cl, _res, mesh_spec = parse_installed_syncer(phys)
+    assert mesh_spec == "4x2"
 
 
 def test_parse_uninstalled_raises():
