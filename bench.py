@@ -108,6 +108,8 @@ def result_json(rps: float, *, provisional: bool, stage: str,
         "target_per_chip": TARGET_CHIP,
         "stage": stage,
     }
+    if "--pallas" in sys.argv or os.environ.get("KCP_PALLAS", "") == "1":
+        out["pallas"] = True
     if provisional:
         out["provisional"] = True
     if segments:
@@ -263,7 +265,10 @@ def main() -> int:
     print(f"bench device: {dev}", file=sys.stderr)
 
     async def run() -> None:
-        core = FusedCore(batch_window=0.0005)
+        # --pallas: serve through the fused Pallas decision+fanout pass
+        # (A/B lane for VERDICT r3 item 3; default is the XLA lanes)
+        core = FusedCore(batch_window=0.0005,
+                         use_pallas=True if "--pallas" in sys.argv else None)
         owner = _BenchOwner(core, B, S)
         bucket = owner.bucket
         bucket.patch_capacity = 8192

@@ -97,7 +97,7 @@ STATS_FIELDS = (
 
 
 def reconcile_step(state: ReconcileState, deltas: ReconcileDeltas,
-                   patch_capacity: int = 8192,
+                   patch_capacity: int = 8192, use_pallas: bool = False,
                    ) -> tuple[ReconcileState, ReconcileOutputs]:
     # 1. scatter deltas, routed by side (ops/diff.apply_deltas owns the
     #    padding-drop and dedup-by-key contract: delta batches must carry
@@ -111,28 +111,48 @@ def reconcile_step(state: ReconcileState, deltas: ReconcileDeltas,
         deltas.vals, deltas.exists, deltas.valid & deltas.side,
     )
 
-    # 2. syncer lanes
-    d = sync_decisions(up_vals, up_exists, down_vals, down_exists, state.status_mask)
+    b = up_vals.shape[0]
+    if use_pallas and b % 128 == 0:
+        # 2+4 fused: one Pallas pass reads each row block into VMEM once
+        # and emits the decision lanes + per-selector match counts
+        # (ops/pallas_kernels.py; differential-tested vs the XLA lanes).
+        # block_rows must DIVIDE b: pick the largest pow2 multiple of the
+        # 128-lane width that does (128 always works given the gate)
+        from ..ops.pallas_kernels import decide_and_match
+
+        br = next(k for k in (4096, 2048, 1024, 512, 256, 128) if b % k == 0)
+        decision, status_upsync, match_counts = decide_and_match(
+            up_vals, up_exists, down_vals, down_exists, state.status_mask,
+            state.pair_hashes, state.sel_hashes, block_rows=br,
+        )
+        matched_total = match_counts.sum(dtype=jnp.int32)
+    else:
+        # 2. syncer lanes
+        d = sync_decisions(up_vals, up_exists, down_vals, down_exists,
+                           state.status_mask)
+        decision, status_upsync = d.decision, d.status_upsync
+
+        # 4. informer fan-out lane — only resident upstream objects fan
+        #    out (pair_hashes rows of deleted objects are stale, not
+        #    cleared)
+        match = fanout_match(state.pair_hashes, state.sel_hashes) & up_exists[:, None]  # [B, C]
+        match_counts = match.sum(axis=0, dtype=jnp.int32)
+        matched_total = match.sum(dtype=jnp.int32)
 
     # 3. splitter lane
     leaf = split_replicas(state.replicas, state.avail)
     p_dirty = placement_changed(state.current, leaf)
 
-    # 4. informer fan-out lane — only resident upstream objects fan out
-    #    (pair_hashes rows of deleted objects are stale, not cleared)
-    match = fanout_match(state.pair_hashes, state.sel_hashes) & up_exists[:, None]  # [B, C]
-    match_counts = match.sum(axis=0, dtype=jnp.int32)
-
     # 5. global stats — under a sharded mesh these reductions lower to
     #    XLA collectives over the tenants/slots axes
     stats = jnp.stack([
         up_exists.sum(dtype=jnp.int32),
-        (d.decision == 1).sum(dtype=jnp.int32),
-        (d.decision == 2).sum(dtype=jnp.int32),
-        (d.decision == 3).sum(dtype=jnp.int32),
-        d.status_upsync.sum(dtype=jnp.int32),
+        (decision == 1).sum(dtype=jnp.int32),
+        (decision == 2).sum(dtype=jnp.int32),
+        (decision == 3).sum(dtype=jnp.int32),
+        status_upsync.sum(dtype=jnp.int32),
         p_dirty.sum(dtype=jnp.int32),
-        match.sum(dtype=jnp.int32),
+        matched_total,
         deltas.valid.sum(dtype=jnp.int32),
     ])
 
@@ -143,12 +163,12 @@ def reconcile_step(state: ReconcileState, deltas: ReconcileDeltas,
         replicas=state.replicas, avail=state.avail, current=leaf,
         pair_hashes=state.pair_hashes, sel_hashes=state.sel_hashes,
     )
-    patches = compact_patches(d.decision, d.status_upsync, patch_capacity)
+    patches = compact_patches(decision, status_upsync, patch_capacity)
     outputs = ReconcileOutputs(
         patch_idx=patches.idx, patch_code=patches.code,
         patch_upsync=patches.upsync, patch_count=patches.count,
         patch_overflow=patches.overflow,
-        decision=d.decision, status_upsync=d.status_upsync,
+        decision=decision, status_upsync=status_upsync,
         leaf_replicas=leaf, placement_dirty=p_dirty,
         match_counts=match_counts, stats=stats,
     )
@@ -156,7 +176,8 @@ def reconcile_step(state: ReconcileState, deltas: ReconcileDeltas,
 
 
 reconcile_step_jit = jax.jit(
-    reconcile_step, donate_argnums=(0,), static_argnames=("patch_capacity",)
+    reconcile_step, donate_argnums=(0,),
+    static_argnames=("patch_capacity", "use_pallas"),
 )
 
 
@@ -210,7 +231,7 @@ def unpack_deltas(packed: jax.Array) -> ReconcileDeltas:
 
 
 def reconcile_step_packed(state: ReconcileState, packed: jax.Array,
-                          patch_capacity: int = 8192,
+                          patch_capacity: int = 8192, use_pallas: bool = False,
                           ) -> tuple[ReconcileState, jax.Array]:
     """The wire-format step: one uint32 array in, one int32 array out.
 
@@ -223,7 +244,8 @@ def reconcile_step_packed(state: ReconcileState, packed: jax.Array,
             f"B={state.up_vals.shape[0]} exceeds {PACK_IDX_MASK} — "
             f"shard the bucket or use the unpacked ReconcileOutputs lanes"
         )
-    new_state, out = reconcile_step(state, unpack_deltas(packed), patch_capacity)
+    new_state, out = reconcile_step(state, unpack_deltas(packed), patch_capacity,
+                                    use_pallas=use_pallas)
     entries = (
         out.patch_idx
         | (out.patch_code.astype(jnp.int32) << PACK_CODE_SHIFT)

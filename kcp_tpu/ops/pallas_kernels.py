@@ -53,7 +53,9 @@ def _decide_match_kernel(up_ref, down_ref, upe_ref, dne_ref, mask_ref,
     up = up_ref[...]          # u32 [PR, 128, S]
     down = down_ref[...]      # u32 [PR, 128, S]
     neq = up != down
-    status = mask_ref[...] != 0  # [1, 1, S]
+    # bucket-wide [1, 1, S] or per-row [PR, 128, S] — both broadcast
+    # against neq (the serving core's shared buckets carry per-row masks)
+    status = mask_ref[...] != 0
     spec_dirty = jnp.any(neq & ~status, axis=-1)    # [PR, 128]
     status_dirty = jnp.any(neq & status, axis=-1)   # [PR, 128]
 
@@ -98,7 +100,7 @@ def decide_and_match(
     up_exists: jax.Array,    # bool [B]
     down_vals: jax.Array,    # uint32 [B, S]
     down_exists: jax.Array,  # bool [B]
-    status_mask: jax.Array,  # bool [S]
+    status_mask: jax.Array,  # bool [S] bucket-wide or [B, S] per-row
     pair_hashes: jax.Array,  # uint32 [B, L]
     sel_hashes: jax.Array,   # uint32 [C]
     block_rows: int = 4096,
@@ -109,11 +111,13 @@ def decide_and_match(
 
     Matches ops.diff.sync_decisions + ops.labelmatch.fanout_match
     (fan-out counted over resident upstream rows), differential-tested
-    against both in tests/test_pallas.py.
+    against both in tests/test_pallas.py. ``status_mask`` may be the
+    bucket-wide [S] form or the serving core's per-row [B, S] form.
     """
     b, s = up_vals.shape
     c = sel_hashes.shape[0]
     l = pair_hashes.shape[1]
+    per_row_mask = status_mask.ndim == 2
     br = min(block_rows, b)
     if b % br:
         raise ValueError(f"B={b} not divisible by block_rows={br}")
@@ -133,6 +137,13 @@ def decide_and_match(
 
     plane = lambda x: x.astype(jnp.int32).reshape(nr, lanes)
 
+    if per_row_mask:
+        mask_spec = val_block(s)
+        mask_arg = status_mask.astype(jnp.int32).reshape(nr, lanes, s)
+    else:
+        mask_spec = bcast3(s)
+        mask_arg = status_mask.astype(jnp.int32)[None, None, :]
+
     decision, upsync, counts = pl.pallas_call(
         _decide_match_kernel,
         grid=grid,
@@ -141,7 +152,7 @@ def decide_and_match(
             val_block(s),          # down_vals
             plane_block,           # up_exists  [NR, 128]
             plane_block,           # down_exists
-            bcast3(s),             # status_mask [1, 1, S]
+            mask_spec,             # status_mask [1,1,S] or [NR,128,S]
             val_block(l),          # pair_hashes [NR, 128, L]
             bcast2(c),             # sel_hashes  [1, C]
         ],
@@ -161,7 +172,7 @@ def decide_and_match(
         down_vals.reshape(nr, lanes, s),
         plane(up_exists),
         plane(down_exists),
-        status_mask.astype(jnp.int32)[None, None, :],
+        mask_arg,
         pair_hashes.reshape(nr, lanes, l),
         sel_hashes[None, :],
     )

@@ -66,8 +66,11 @@ def mesh_from_spec(spec: str, devices: list | None = None) -> Mesh:
     ``"2x2x2"`` -> (hosts=2, tenants=2, slots=2). The flat device count
     must be available.
     """
-    dims = [int(d) for d in spec.lower().replace("*", "x").split("x") if d]
-    if not dims or any(d < 1 for d in dims) or len(dims) > 3:
+    parts = spec.lower().replace("*", "x").split("x")
+    if not parts or any(not p.strip().isdigit() for p in parts):
+        raise ValueError(f"bad mesh spec {spec!r}: want N, NxM or NxMxK")
+    dims = [int(p) for p in parts]
+    if any(d < 1 for d in dims) or len(dims) > 3:
         raise ValueError(f"bad mesh spec {spec!r}: want N, NxM or NxMxK")
     if len(dims) == 1:
         return make_mesh(n_devices=dims[0], slots=1, devices=devices)

@@ -168,6 +168,13 @@ class Server:
         for c in reversed(self._controllers):
             await c.stop()
         self._controllers = []
+        if self.config.mesh:
+            # this server installed the process serving mesh — clear it so
+            # a later server/syncer in this process doesn't inherit stale
+            # sharding nobody configured
+            from ..parallel.mesh import set_serving_mesh
+
+            set_serving_mesh(None)
         await self.http.stop()
         if self.config.durable:
             self.store.snapshot()

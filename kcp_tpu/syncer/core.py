@@ -138,10 +138,13 @@ class Section:
 class FusedBucket:
     """One schema bucket: host staging + device-resident fused state."""
 
-    def __init__(self, slots: int, mesh=None):
+    def __init__(self, slots: int, mesh=None, use_pallas: bool = False):
         self.S = slots
         self.B = 0
         self.mesh = mesh
+        # the fused Pallas decision+fanout pass (ops/pallas_kernels.py);
+        # single-device only — the sharded path keeps the XLA lanes
+        self.use_pallas = use_pallas and mesh is None
         # sharded state must device_put cleanly: row counts are padded to
         # a multiple of the row-axis product (see _grow), and the slots
         # axis must divide the (power-of-two) slot capacity up front
@@ -174,7 +177,7 @@ class FusedBucket:
         self._staged: dict[tuple[int, bool], tuple[np.ndarray, bool]] = {}
         self._step = jax.jit(
             reconcile_step_packed, donate_argnums=(0,),
-            static_argnames=("patch_capacity",),
+            static_argnames=("patch_capacity", "use_pallas"),
         )
         self.stats = {"ticks": 0, "full_uploads": 0, "overflows": 0}
 
@@ -314,7 +317,8 @@ class FusedBucket:
         else:
             packed = jax.device_put(packed)
         self._state, wire = self._step(
-            self._state, packed, patch_capacity=min(self.patch_capacity, self.B)
+            self._state, packed, patch_capacity=min(self.patch_capacity, self.B),
+            use_pallas=self.use_pallas,
         )
         wire.copy_to_host_async()
         self.stats["ticks"] += 1
@@ -347,8 +351,18 @@ class FusedCore:
 
     _instances: dict[int, "FusedCore"] = {}
 
-    def __init__(self, mesh=None, batch_window: float = 0.002):
+    def __init__(self, mesh=None, batch_window: float = 0.002,
+                 use_pallas: bool | None = None):
         self.mesh = mesh
+        if use_pallas is None:
+            import os
+
+            use_pallas = os.environ.get("KCP_PALLAS", "") == "1"
+        self.use_pallas = use_pallas
+        if use_pallas and mesh is not None:
+            log.warning("KCP_PALLAS requested with a mesh; the fused "
+                        "Pallas pass is single-device only — using the "
+                        "XLA lanes for sharded buckets")
         self.buckets: dict[int, FusedBucket] = {}
         self.controller = BatchController(
             "fused-core", self._process_batch, batch_window=batch_window
@@ -419,7 +433,7 @@ class FusedCore:
     def bucket(self, slots: int) -> FusedBucket:
         b = self.buckets.get(slots)
         if b is None:
-            b = FusedBucket(slots, mesh=self.mesh)
+            b = FusedBucket(slots, mesh=self.mesh, use_pallas=self.use_pallas)
             self.buckets[slots] = b
         return b
 

@@ -129,3 +129,112 @@ class TestDecideAndMatch:
         with pytest.raises(ValueError, match="not divisible"):
             decide_and_match(up, upe, down, dne, mask, pair, sel,
                              block_rows=64, interpret=True)
+
+
+class TestPerRowMask:
+    """The serving core's shared buckets carry [B, S] per-row masks —
+    the kernel must accept them (round-4 integration)."""
+
+    @pytest.mark.parametrize("seed", [0, 5])
+    def test_per_row_mask_matches_reference(self, seed):
+        rng = np.random.default_rng(seed)
+        up, upe, down, dne, _mask, pair, sel = _random_case(rng)
+        b, s = up.shape
+        rowmask = rng.random((b, s)) < 0.4
+
+        decision, upsync, counts = decide_and_match(
+            up, upe, down, dne, rowmask, pair, sel, block_rows=64,
+            interpret=True,
+        )
+        ref = sync_decisions(
+            jnp.asarray(up), jnp.asarray(upe), jnp.asarray(down),
+            jnp.asarray(dne), jnp.asarray(rowmask),
+        )
+        np.testing.assert_array_equal(np.asarray(decision), np.asarray(ref.decision))
+        np.testing.assert_array_equal(np.asarray(upsync), np.asarray(ref.status_upsync))
+        match = np.asarray(fanout_match(jnp.asarray(pair), jnp.asarray(sel)))
+        np.testing.assert_array_equal(
+            np.asarray(counts), (match & upe[:, None]).sum(axis=0))
+
+
+class TestReconcileStepPallasLane:
+    """use_pallas=True is the SERVED integration (FusedBucket passes it
+    when KCP_PALLAS=1): the whole step must be bit-identical."""
+
+    def test_step_identical_with_and_without_pallas(self):
+        from kcp_tpu.models.reconcile_model import (
+            example_deltas, example_state, reconcile_step,
+        )
+
+        state = example_state(b=256, s=64, r=16, p=4, l=8, c=16, dirty_frac=0.2)
+        deltas = example_deltas(b=256, s=64, d=32)
+        _, ref = jax.jit(reconcile_step,
+                         static_argnames=("use_pallas",))(state, deltas)
+        _, out = jax.jit(reconcile_step,
+                         static_argnames=("use_pallas",))(
+            state, deltas, use_pallas=True)
+        for name in ref._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(ref, name)), np.asarray(getattr(out, name)),
+                err_msg=name)
+
+    def test_served_core_with_pallas_end_to_end(self):
+        """start_syncer with a KCP_PALLAS core: sync results identical to
+        the XLA path (the serving-level differential test)."""
+        import asyncio
+
+        from kcp_tpu.client import Client
+        from kcp_tpu.store import LogicalStore
+        from kcp_tpu.syncer import start_syncer
+        from kcp_tpu.syncer.core import FusedCore
+        from kcp_tpu.syncer.engine import CLUSTER_LABEL
+
+        def cm(name, data):
+            return {"apiVersion": "v1", "kind": "ConfigMap",
+                    "metadata": {"name": name, "namespace": "default",
+                                 "labels": {CLUSTER_LABEL: "c1"}},
+                    "data": data}
+
+        async def eventually(pred, timeout=15.0):
+            deadline = asyncio.get_event_loop().time() + timeout
+            while True:
+                try:
+                    if pred():
+                        return
+                except Exception:
+                    pass
+                if asyncio.get_event_loop().time() > deadline:
+                    raise AssertionError("condition not reached")
+                await asyncio.sleep(0.01)
+
+        async def drive(use_pallas):
+            kcp, phys = LogicalStore(), LogicalStore()
+            up, down = Client(kcp, "t"), Client(phys, "p")
+            syncer = await start_syncer(up, down, ["configmaps"], "c1",
+                                        backend="tpu")
+            eng = syncer.engines[0]
+            assert eng.core.use_pallas == use_pallas
+            # >128 objects so B grows past the b%128 gate and the Pallas
+            # path actually runs
+            for i in range(150):
+                up.create("configmaps", cm(f"cm-{i}", {"v": str(i)}))
+            await eventually(lambda: len(down.list("configmaps")[0]) == 150)
+            dump = {o["metadata"]["name"]: o["data"]
+                    for o in down.list("configmaps")[0]}
+            bucket = eng._section.bucket
+            assert bucket.B >= 256
+            assert bucket.use_pallas == use_pallas
+            await syncer.stop()
+            return dump
+
+        async def scenario(use_pallas):
+            # bind a pre-made core to this loop so for_current_loop
+            # returns it (env-independent constructor arg)
+            core = FusedCore(use_pallas=use_pallas)
+            core._loop = asyncio.get_running_loop()
+            FusedCore._instances[id(core._loop)] = core
+            return await drive(use_pallas)
+
+        with_pallas = asyncio.run(scenario(True))
+        without = asyncio.run(scenario(False))
+        assert with_pallas == without
