@@ -184,10 +184,7 @@ class _BenchOwner:
         # sample two dispatches out: by then the tick that scattered this
         # feedback has itself been collected (FIFO pipeline, depth 1)
         self._awaiting.append((self.dispatches + 2, self.t_create[rows].copy()))
-        enqueue = self.core.enqueue
-        section = self.section
-        for k in rows.tolist():
-            enqueue(section, True, k)
+        self.core.enqueue_many(self.section, True, rows.tolist())
 
     # ------------------------------------------------------------- churn
 
@@ -196,10 +193,7 @@ class _BenchOwner:
         self.bucket.up_vals[rows] = self.rng.integers(
             1, 2**32, (n, self.S), dtype=np.uint32)
         self.t_create[rows] = time.perf_counter()
-        enqueue = self.core.enqueue
-        section = self.section
-        for k in rows.tolist():
-            enqueue(section, False, k)
+        self.core.enqueue_many(self.section, False, rows.tolist())
 
 
 class Deadman:

@@ -70,6 +70,10 @@ class Controller:
     def enqueue(self, item: Item) -> None:
         self.queue.add(item)
 
+    def enqueue_many(self, items) -> None:
+        """Batch enqueue (one queue crossing; see WorkQueue.add_many)."""
+        self.queue.add_many(items)
+
     def enqueue_after(self, item: Item, delay: float) -> None:
         self.queue.add_after(item, delay)
 
@@ -165,7 +169,8 @@ class BatchController(Controller):
             for item, err in failed:
                 failed_items.add(item)
                 self._handle_error(item, err)
-            for item in batch:
-                if item not in failed_items:
-                    self.queue.forget(item)
-                self.queue.done(item)
+            # one queue crossing for the whole batch (forget successes,
+            # done everything) — the per-item form cost ~30% of the
+            # serving loop's wall time at bench scale
+            self.queue.complete_many(
+                batch, [item not in failed_items for item in batch])

@@ -84,6 +84,11 @@ class FairWorkQueue:
         lib.wq_live.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
         lib.wq_release.restype = ctypes.c_int
         lib.wq_release.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        u32p = ctypes.POINTER(ctypes.c_uint32)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.wq_add_many.argtypes = [ctypes.c_void_p, u64p, u32p, ctypes.c_uint32]
+        lib.wq_complete_many.argtypes = [ctypes.c_void_p, u64p, u8p,
+                                         ctypes.c_uint32, u8p]
         lib._wq_declared = True
 
     # ---------------------------------------------------------- id mapping
@@ -111,6 +116,52 @@ class FairWorkQueue:
         if self._shutdown:
             return
         self._lib.wq_add(self._q, self._id(item), self._tenant(item))
+        self._wakeup.set()
+
+    def add_many(self, items) -> None:
+        """Batch add: one ctypes crossing + one wakeup for a whole
+        churn/feedback batch (the round-4 profile's top host cost)."""
+        if self._shutdown:
+            return
+        items = list(items)
+        n = len(items)
+        if not n:
+            return
+        ids = (ctypes.c_uint64 * n)()
+        tenants = (ctypes.c_uint32 * n)()
+        for j, item in enumerate(items):
+            ids[j] = self._id(item)
+            tenants[j] = self._tenant(item)
+        self._lib.wq_add_many(self._q, ids, tenants, n)
+        self._wakeup.set()
+
+    def complete_many(self, items, forget_flags) -> None:
+        """Batch forget+done for a processed tick batch; releases the id
+        interning of every item that left the queue."""
+        items = list(items)
+        n = len(items)
+        if not n:
+            return
+        ids = (ctypes.c_uint64 * n)()
+        forgets = (ctypes.c_uint8 * n)()
+        released = (ctypes.c_uint8 * n)()
+        known: list[tuple[int, Item, int]] = []
+        for item, fg in zip(items, forget_flags):
+            i = self._ids.get(item)
+            if i is None:
+                continue
+            j = len(known)
+            ids[j] = i
+            forgets[j] = 1 if fg else 0
+            known.append((j, item, i))
+        if not known:
+            return
+        self._lib.wq_complete_many(self._q, ids, forgets, len(known), released)
+        for j, item, i in known:
+            if released[j]:
+                del self._ids[item]
+                del self._items[i]
+        # done() may have requeued redo items natively — wake any getter
         self._wakeup.set()
 
     def add_after(self, item: Item, delay: float) -> None:

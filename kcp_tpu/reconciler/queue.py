@@ -74,6 +74,21 @@ class WorkQueue:
     def forget(self, item: Item) -> None:
         self._retries.pop(item, None)
 
+    # ------------------------------------------------------- batch forms
+    # (the native FairWorkQueue crosses ctypes once per batch; these
+    # fallback loops keep the interface identical)
+
+    def add_many(self, items) -> None:
+        for item in items:
+            self.add(item)
+
+    def complete_many(self, items, forget_flags) -> None:
+        """forget (where flagged) + done for a processed tick batch."""
+        for item, fg in zip(items, forget_flags):
+            if fg:
+                self.forget(item)
+            self.done(item)
+
     # ---------------------------------------------------------- consuming
 
     def _promote_delayed(self) -> float | None:

@@ -16,6 +16,14 @@ objects mean something:
   supported; the well-known ``cluster-admin`` role name short-circuits.
 - Cross-tenant wildcard reads (``/clusters/*``) require the caller to be
   admin in the root cluster, since they traverse every tenant at once.
+- **Escalation prevention** (Kubernetes' RBAC escalation check, which
+  the reference inherits from its forked generic control plane): writes
+  to ``clusterroles`` are denied unless the writer already holds every
+  permission the role grants (or holds the ``escalate`` verb on
+  clusterroles); writes to ``clusterrolebindings`` are denied unless the
+  writer already holds the referenced role's permissions (or holds the
+  ``bind`` verb on clusterroles). Without this, any user granted
+  ``create`` on clusterrolebindings could bind themselves cluster-admin.
 
 Evaluation is pure host-side policy (small, irregular, latency-bound —
 nothing to batch); enforcement sits in the REST handler so the
@@ -101,6 +109,82 @@ class Authorizer:
                 if _rule_matches(rule, verb, group, resource):
                     return True
         return False
+
+    # ------------------------------------------------- escalation check
+
+    def _covers(self, user: str, cluster: str, rules: list) -> bool:
+        """Does the user already hold every permission ``rules`` grants?
+        Wildcards are only covered by wildcards (a user without ``*``
+        cannot grant ``*``), matching Kubernetes' covers semantics.
+
+        The user's effective rule set is resolved ONCE (one binding list
+        + one get per bound role), then each requested permission is
+        cover-matched in memory — a wide submitted role must not amplify
+        into per-combination store evaluations. Rules that are not even
+        dict-shaped cannot be verified and are denied."""
+        if user == ADMIN_USER:
+            return True
+        held: list[dict] = []
+        for role_name in self._roles_for(user, cluster):
+            if role_name == CLUSTER_ADMIN_ROLE:
+                return True
+            try:
+                role = self.store.get(CLUSTERROLES, cluster, role_name)
+            except NotFoundError:
+                continue
+            held.extend(r for r in role.get("rules", []) if isinstance(r, dict))
+        for rule in rules:
+            if not isinstance(rule, dict):
+                return False
+            for verb in rule.get("verbs", []):
+                for group in rule.get("apiGroups", [""]):
+                    for resource in rule.get("resources", []):
+                        if not any(_rule_matches(h, verb, group, resource)
+                                   for h in held):
+                            return False
+        return True
+
+    def escalation_denied(self, user: str, cluster: str, resource: str,
+                          body: dict | None) -> str | None:
+        """For a clusterrole/clusterrolebinding write, a denial message if
+        the writer would grant permissions they do not hold; None = allow.
+
+        Mirrors Kubernetes' RBAC escalation prevention: the ``escalate``
+        verb (on clusterroles) bypasses the role check, the ``bind`` verb
+        bypasses the binding check."""
+        if user == ADMIN_USER:
+            return None
+        body = body or {}
+        if resource == "clusterroles":
+            if self.allowed(user, cluster, "escalate",
+                            "rbac.authorization.k8s.io", "clusterroles"):
+                return None
+            if not self._covers(user, cluster, body.get("rules", [])):
+                return (f'user "{user}" cannot create/update a clusterrole '
+                        f"granting permissions they do not hold "
+                        f"(escalation check; needs the \"escalate\" verb)")
+        elif resource == "clusterrolebindings":
+            if self.allowed(user, cluster, "bind",
+                            "rbac.authorization.k8s.io", "clusterroles"):
+                return None
+            role_name = (body.get("roleRef") or {}).get("name", "")
+            if role_name == CLUSTER_ADMIN_ROLE:
+                if CLUSTER_ADMIN_ROLE in self._roles_for(user, cluster):
+                    return None
+                return (f'user "{user}" cannot bind "{CLUSTER_ADMIN_ROLE}" '
+                        f"without holding it (escalation check)")
+            try:
+                role = self.store.get(CLUSTERROLES, cluster, role_name)
+            except NotFoundError:
+                # binding a nonexistent role grants nothing today, but a
+                # later role create would retroactively arm it — deny
+                return (f'user "{user}" cannot bind nonexistent role '
+                        f'"{role_name}" (escalation check)')
+            if not self._covers(user, cluster, role.get("rules", [])):
+                return (f'user "{user}" cannot bind role "{role_name}" '
+                        f"granting permissions they do not hold "
+                        f"(escalation check; needs the \"bind\" verb)")
+        return None
 
 
 def verb_for(method: str, has_name: bool, is_watch: bool) -> str:

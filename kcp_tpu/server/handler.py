@@ -87,6 +87,29 @@ class RestHandler:
 
             return Response(body=REGISTRY.expose().encode("utf-8"),
                             content_type="text/plain; version=0.0.4")
+        if head == "debug" and segs[1:] == ["profile"]:
+            # the /debug/pprof analog (reference pkg/server/server.go:145
+            # inherits it from the apiserver chain): sampling wall profile
+            # + asyncio task dump + span histograms. Server-global, so
+            # with authz on it is gated like cross-tenant reads (root
+            # cluster-admin), matching pprof-on-the-secure-port semantics.
+            if self.authorizer is not None:
+                from ..store.store import WILDCARD
+
+                user = self.authenticator.user_for(req.headers)
+                if not self.authorizer.allowed(user, WILDCARD, "get", "",
+                                               "debug"):
+                    return Response.of_json(
+                        _status_body(403, "Forbidden",
+                                     f'user "{user}" cannot read /debug/profile'),
+                        403)
+            from ..utils.trace import sample_profile
+
+            try:
+                seconds = float(req.param("seconds", "2.0"))
+            except (TypeError, ValueError):
+                seconds = 2.0
+            return Response.of_json(await sample_profile(seconds))
         if head == "api":
             return await self._route_group(req, cluster, group="", segs=segs[1:])
         if head == "apis":
@@ -171,6 +194,24 @@ class RestHandler:
                     _status_body(403, "Forbidden",
                                  f'user "{user}" cannot {verb} {resource} '
                                  f'in logical cluster "{cluster}"'), 403)
+            if (verb in ("create", "update", "patch")
+                    and group == "rbac.authorization.k8s.io"
+                    and resource in ("clusterroles", "clusterrolebindings")):
+                # RBAC writes additionally pass Kubernetes' escalation
+                # check: you cannot grant what you do not hold
+                try:
+                    body = req.json()
+                except ValueError:
+                    body = None
+                if not isinstance(body, dict):
+                    # malformed bodies fall through to _serve_resource's
+                    # 400; the check itself must not crash on them
+                    body = None
+                denial = self.authorizer.escalation_denied(
+                    user, cluster, resource, body)
+                if denial:
+                    return Response.of_json(
+                        _status_body(403, "Forbidden", denial), 403)
         try:
             return await self._serve_resource(req, cluster, info, namespace, name, subresource)
         except errors.ApiError as e:

@@ -134,6 +134,7 @@ class Server:
             from ..parallel.mesh import set_serving_mesh
 
             mesh = set_serving_mesh(self.config.mesh)
+            self._installed_mesh = mesh
             log.info("serving mesh: %s",
                      dict(zip(mesh.axis_names, mesh.devices.shape)))
         self._controllers = [
@@ -168,13 +169,16 @@ class Server:
         for c in reversed(self._controllers):
             await c.stop()
         self._controllers = []
-        if self.config.mesh:
-            # this server installed the process serving mesh — clear it so
-            # a later server/syncer in this process doesn't inherit stale
-            # sharding nobody configured
-            from ..parallel.mesh import set_serving_mesh
+        if getattr(self, "_installed_mesh", None) is not None:
+            # clear the process serving mesh so a later server/syncer in
+            # this process doesn't inherit stale sharding — but only if
+            # OUR mesh is still the installed one (another live server
+            # may have replaced it since)
+            from ..parallel.mesh import get_serving_mesh, set_serving_mesh
 
-            set_serving_mesh(None)
+            if get_serving_mesh() is self._installed_mesh:
+                set_serving_mesh(None)
+            self._installed_mesh = None
         await self.http.stop()
         if self.config.durable:
             self.store.snapshot()

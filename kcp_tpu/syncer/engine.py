@@ -254,8 +254,7 @@ class BatchSyncEngine:
         self._section = self.core.register(self, self.enc.capacity)
         if old is not None:
             old.release()
-        for key in self._all_keys():
-            self.core.enqueue(self._section, False, key)
+        self.core.enqueue_many(self._section, False, self._all_keys())
 
     def _all_keys(self) -> set:
         keys = {(k[1], k[2]) for k in self.up_informer.cache}

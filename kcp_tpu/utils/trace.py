@@ -189,3 +189,101 @@ def device_trace(log_dir: str):
                 jax.profiler.stop_trace()
             except Exception:
                 pass
+
+
+# ---------------------------------------------------------------------------
+# Host profiling (the reference inherits /debug/pprof from its generic
+# apiserver chain, pkg/server/server.go:145; this is the asyncio-native
+# analog): a sampling wall profiler over every thread's stack plus an
+# asyncio task dump, served at /debug/profile by the REST handler.
+# ---------------------------------------------------------------------------
+
+
+def dump_tasks() -> list[dict]:
+    """All live asyncio tasks of the running loop with their current
+    coroutine stacks — who is waiting where."""
+    import asyncio
+
+    try:
+        loop = asyncio.get_running_loop()
+    except RuntimeError:
+        return []
+    out = []
+    for t in asyncio.all_tasks(loop):
+        frames = []
+        for f in t.get_stack(limit=8):
+            frames.append(f"{f.f_code.co_filename.rsplit('/', 1)[-1]}:"
+                          f"{f.f_lineno} {f.f_code.co_name}")
+        out.append({
+            "name": t.get_name(),
+            "coro": getattr(t.get_coro(), "__qualname__", str(t.get_coro())),
+            "done": t.done(),
+            "stack": frames,
+        })
+    return sorted(out, key=lambda d: d["name"])
+
+
+def _sample_once(agg: dict, skip_thread: int) -> None:
+    import sys
+
+    for tid, frame in sys._current_frames().items():
+        if tid == skip_thread:
+            continue
+        stack = []
+        f = frame
+        depth = 0
+        while f is not None and depth < 24:
+            stack.append(f"{f.f_code.co_filename.rsplit('/', 1)[-1]}:"
+                         f"{f.f_lineno} {f.f_code.co_name}")
+            f = f.f_back
+            depth += 1
+        key = (tid, tuple(stack))
+        agg[key] = agg.get(key, 0) + 1
+
+
+async def sample_profile(seconds: float = 2.0, hz: float = 97.0) -> dict:
+    """Statistical wall profile: a sampler thread walks every thread's
+    stack at ~hz for ``seconds`` while the loop keeps serving. Returns
+    aggregated stacks with sample counts (top 20), plus the asyncio task
+    dump and the span/metric snapshot — everything needed to answer
+    "where does tick time go" without stopping the server."""
+    import asyncio
+    import threading
+
+    seconds = max(0.1, min(float(seconds), 10.0))
+    agg: dict = {}
+    done = threading.Event()
+
+    def run() -> None:
+        me = threading.get_ident()
+        interval = 1.0 / hz
+        end = time.perf_counter() + seconds
+        while time.perf_counter() < end:
+            _sample_once(agg, me)
+            time.sleep(interval)
+        done.set()
+
+    t = threading.Thread(target=run, name="kcp-profiler", daemon=True)
+    tasks_before = dump_tasks()
+    t.start()
+    while not done.is_set():
+        await asyncio.sleep(0.02)
+
+    names = {th.ident: th.name for th in threading.enumerate()}
+    total = sum(agg.values()) or 1
+    stacks = sorted(agg.items(), key=lambda kv: -kv[1])[:20]
+    return {
+        "seconds": seconds,
+        "samples": total,
+        "stacks": [
+            {
+                "thread": names.get(tid, str(tid)),
+                "count": n,
+                "pct": round(100.0 * n / total, 1),
+                "stack": list(stack),
+            }
+            for (tid, stack), n in stacks
+        ],
+        "tasks": tasks_before,
+        "spans": REGISTRY.snapshot(),
+    }

@@ -188,6 +188,35 @@ uint32_t wq_drain(void* q, double now, uint64_t* out, uint32_t max_items) {
 
 void wq_done(void* q, uint64_t id) { static_cast<FairQueue*>(q)->done(id); }
 
+// Batch enqueue: one ctypes crossing for a whole churn/feedback batch.
+// Profiling (round 4) showed per-item add() crossings costing ~15% of
+// the serving loop's wall time at 1.5k events/tick.
+void wq_add_many(void* q, const uint64_t* ids, const uint32_t* tenants,
+                 uint32_t n) {
+  auto* fq = static_cast<FairQueue*>(q);
+  for (uint32_t i = 0; i < n; ++i) fq->add(ids[i], tenants[i]);
+}
+
+// Batch forget+done for a processed tick batch (~30% of loop wall time
+// as per-item crossings). forget[i]=1 clears the retry counter (the
+// success path). out_released[i]=1 when the id left the queue entirely —
+// the caller then drops its interning entry.
+void wq_complete_many(void* q, const uint64_t* ids, const uint8_t* forget,
+                      uint32_t n, uint8_t* out_released) {
+  auto* fq = static_cast<FairQueue*>(q);
+  for (uint32_t i = 0; i < n; ++i) {
+    const uint64_t id = ids[i];
+    if (forget[i]) fq->retries.erase(id);
+    fq->done(id);
+    if (fq->live(id)) {
+      out_released[i] = 0;
+    } else {
+      fq->retries.erase(id);
+      out_released[i] = 1;
+    }
+  }
+}
+
 uint64_t wq_len(void* q) {
   auto* fq = static_cast<FairQueue*>(q);
   return fq->ready_count + fq->delayed_ids.size();

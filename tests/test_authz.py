@@ -140,3 +140,112 @@ def test_handler_open_without_authorizer():
         assert resp.status == 200
 
     asyncio.run(main())
+
+
+def test_escalation_check_closes_the_privilege_hole():
+    """The round-1..3 hole: a user with create on clusterrolebindings
+    could bind themselves cluster-admin. Now RBAC writes pass
+    Kubernetes' escalation check (authz.py escalation_denied)."""
+
+    async def main():
+        store = LogicalStore()
+        authn = Authenticator(tokens={"mallory-tok": "mallory",
+                                      "ops-tok": "ops"})
+        handler = RestHandler(store, default_scheme(),
+                              authenticator=authn, authorizer=Authorizer(store))
+        rbac = "/clusters/team-a/apis/rbac.authorization.k8s.io/v1"
+
+        # mallory holds create/update on rolebindings + roles (the
+        # classic delegated-admin footgun) but nothing else
+        _grant(store, "team-a", "mallory", "rbac-editor", rules=[
+            {"verbs": ["create", "update", "get"],
+             "apiGroups": ["rbac.authorization.k8s.io"],
+             "resources": ["clusterrolebindings", "clusterroles"]},
+        ])
+        hdr = {"authorization": "Bearer mallory-tok"}
+
+        # 1. binding herself cluster-admin: DENIED
+        resp = await handler(_req(
+            "POST", f"{rbac}/clusterrolebindings", hdr,
+            body=json.dumps({
+                "metadata": {"name": "evil"},
+                "subjects": [{"kind": "User", "name": "mallory"}],
+                "roleRef": {"name": "cluster-admin"},
+            }).encode()))
+        assert resp.status == 403, resp.body
+        assert b"escalation" in resp.body
+
+        # 2. creating a role wider than her own permissions: DENIED
+        resp = await handler(_req(
+            "POST", f"{rbac}/clusterroles", hdr,
+            body=json.dumps({
+                "metadata": {"name": "wide"},
+                "rules": [{"verbs": ["*"], "apiGroups": ["*"],
+                           "resources": ["*"]}],
+            }).encode()))
+        assert resp.status == 403
+        assert b"escalation" in resp.body
+
+        # 3. binding an existing role whose permissions she does not
+        #    hold: DENIED (secrets-reader grants what mallory lacks)
+        store.create(CLUSTERROLES, "team-a", {
+            "metadata": {"name": "secrets-reader"},
+            "rules": [{"verbs": ["get"], "apiGroups": [""],
+                       "resources": ["secrets"]}]})
+        resp = await handler(_req(
+            "POST", f"{rbac}/clusterrolebindings", hdr,
+            body=json.dumps({
+                "metadata": {"name": "grab-secrets"},
+                "subjects": [{"kind": "User", "name": "mallory"}],
+                "roleRef": {"name": "secrets-reader"},
+            }).encode()))
+        assert resp.status == 403
+
+        # 4. a role bounded by what she holds: ALLOWED
+        resp = await handler(_req(
+            "POST", f"{rbac}/clusterroles", hdr,
+            body=json.dumps({
+                "metadata": {"name": "rb-creator"},
+                "rules": [{"verbs": ["create"],
+                           "apiGroups": ["rbac.authorization.k8s.io"],
+                           "resources": ["clusterrolebindings"]}],
+            }).encode()))
+        assert resp.status in (200, 201), resp.body
+
+        # 5. ops holds the "escalate"/"bind" verbs: both writes ALLOWED
+        _grant(store, "team-a", "ops", "rbac-admin", rules=[
+            {"verbs": ["create", "update", "escalate", "bind"],
+             "apiGroups": ["rbac.authorization.k8s.io"],
+             "resources": ["clusterroles", "clusterrolebindings"]},
+        ])
+        ohdr = {"authorization": "Bearer ops-tok"}
+        resp = await handler(_req(
+            "POST", f"{rbac}/clusterroles", ohdr,
+            body=json.dumps({
+                "metadata": {"name": "anything"},
+                "rules": [{"verbs": ["*"], "apiGroups": ["*"],
+                           "resources": ["*"]}],
+            }).encode()))
+        assert resp.status in (200, 201), resp.body
+        resp = await handler(_req(
+            "POST", f"{rbac}/clusterrolebindings", ohdr,
+            body=json.dumps({
+                "metadata": {"name": "ops-binds-admin"},
+                "subjects": [{"kind": "User", "name": "someone"}],
+                "roleRef": {"name": "cluster-admin"},
+            }).encode()))
+        assert resp.status in (200, 201), resp.body
+
+        # 6. admin bypasses the check entirely
+        # (the minted identity, reference server.go:151-176)
+        # and binding a nonexistent role is denied for mallory
+        resp = await handler(_req(
+            "POST", f"{rbac}/clusterrolebindings", hdr,
+            body=json.dumps({
+                "metadata": {"name": "dangling"},
+                "subjects": [{"kind": "User", "name": "mallory"}],
+                "roleRef": {"name": "ghost-role"},
+            }).encode()))
+        assert resp.status == 403
+
+    asyncio.run(main())
