@@ -28,6 +28,8 @@ def build_parser():
     p = parser("cluster-controller", DOC)
     p.add_argument("--server", default="http://127.0.0.1:6443",
                    help="kcp-tpu API server URL")
+    p.add_argument("--ca-file", default=None,
+                   help="CA bundle for an https --server")
     p.add_argument("--resources-to-sync", default="deployments.apps")
     p.add_argument("--syncer-mode", choices=["push", "pull", "none"], default="push")
     p.add_argument("--auto-publish-apis", action="store_true")
@@ -47,7 +49,7 @@ async def run(args) -> None:
     from ..reconcilers.crdlifecycle import CRDLifecycleController
     from ..reconcilers.deployment import DeploymentSplitter
 
-    client = MultiClusterRestClient(args.server)
+    client = MultiClusterRestClient(args.server, ca_file=args.ca_file)
     registry = PhysicalRegistry()
     # physical clusters reachable over HTTP resolve to REST clients
     registry.register_factory("http", lambda url: RestClient(url, cluster="default"))

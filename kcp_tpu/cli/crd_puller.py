@@ -25,6 +25,9 @@ def build_parser():
     p.add_argument("--server", required=True,
                    help="cluster URL (reference: -kubeconfig)")
     p.add_argument("--cluster", default="default")
+    p.add_argument("--ca-file", default=None,
+                   help="CA bundle for an https --server (e.g. the kcp "
+                        "root dir's pki/ca.crt)")
     p.add_argument("--out-dir", default=".")
     p.add_argument("resources", nargs="+",
                    help="resources to pull, e.g. deployments.apps")
@@ -37,7 +40,8 @@ def main(argv: list[str] | None = None) -> int:
     apply_platform_env()
     args = build_parser().parse_args(argv)
     logging.basicConfig(level=logging.INFO)
-    client = RestClient(args.server, cluster=args.cluster)
+    client = RestClient(args.server, cluster=args.cluster,
+                        ca_file=args.ca_file)
     puller = SchemaPuller(client)
     pulled = puller.pull_crds(args.resources)
     rc = 0

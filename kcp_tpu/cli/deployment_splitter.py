@@ -26,13 +26,15 @@ def build_parser():
     p.add_argument("--server", default="http://127.0.0.1:6443",
                    help="kcp-tpu API server URL (reference: -kubeconfig)")
     p.add_argument("--backend", choices=["tpu", "host"], default="tpu")
+    p.add_argument("--ca-file", default=None,
+                   help="CA bundle for an https --server")
     return p
 
 
 async def run(args) -> None:
     from ..reconcilers.deployment import DeploymentSplitter
 
-    client = MultiClusterRestClient(args.server)
+    client = MultiClusterRestClient(args.server, ca_file=args.ca_file)
     splitter = DeploymentSplitter(client, backend=args.backend)
     await splitter.start()
     stop = asyncio.Event()

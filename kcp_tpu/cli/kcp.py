@@ -60,6 +60,9 @@ def build_parser():
                             "minted into admin.kubeconfig")
     start.add_argument("--admin-token", default="",
                        help="fixed admin bearer token (minted when empty)")
+    start.add_argument("--no-tls", action="store_true",
+                       help="serve plaintext HTTP instead of the default "
+                            "self-signed TLS endpoint")
     start.add_argument("--mesh", default="",
                        help="serving-mesh spec to shard the fused reconcile "
                             "core over jax devices: N (tenants), NxM "
@@ -91,6 +94,7 @@ def config_from_args(args) -> Config:
         import_poll_interval=args.poll_interval,
         authz=args.authz,
         admin_token=args.admin_token,
+        tls=not args.no_tls,
         mesh=args.mesh,
     )
 

@@ -63,6 +63,11 @@ def build_parser(pod_form_only: bool = False):
                    help="sync target id — the kcp.dev/cluster label value "
                         "(reference: -cluster)")
     p.add_argument("--backend", choices=["tpu", "host"], default="tpu")
+    p.add_argument("--from-ca-file", default=None,
+                   help="CA bundle for an https --from-server (a kubeconfig's "
+                        "certificate-authority-data is used automatically)")
+    p.add_argument("--to-ca-file", default=None,
+                   help="CA bundle for an https --to-server")
     p.add_argument("--mesh", default="",
                    help="serving-mesh spec (N, NxM or NxMxK) to shard the "
                         "fused core over jax devices")
@@ -71,10 +76,12 @@ def build_parser(pod_form_only: bool = False):
     return p
 
 
-def kubeconfig_credentials(content: str) -> tuple[str, str]:
-    """(server URL, bearer token) of the current context in a kubeconfig
-    (the JSON shape render_kubeconfig writes; token empty when the
-    server runs open)."""
+def kubeconfig_credentials(content: str) -> tuple[str, str, bytes | None]:
+    """(server URL, bearer token, CA PEM or None) of the current context
+    in a kubeconfig (the JSON shape render_kubeconfig writes; token empty
+    when the server runs open, CA present when it serves TLS)."""
+    import base64
+
     cfg = json.loads(content)
     current = cfg.get("current-context", "")
     ctx = next((c["context"] for c in cfg.get("contexts", [])
@@ -85,21 +92,26 @@ def kubeconfig_credentials(content: str) -> tuple[str, str]:
                   for u in cfg.get("users", []) if u.get("name") == user_name), "")
     for c in cfg.get("clusters", []):
         if c.get("name") == cluster_name:
-            return c["cluster"]["server"], token
+            ca_b64 = c["cluster"].get("certificate-authority-data", "")
+            ca = base64.b64decode(ca_b64) if ca_b64 else None
+            return c["cluster"]["server"], token, ca
     raise ValueError(f"kubeconfig has no cluster {cluster_name!r}")
 
 
 async def run(args) -> None:
     from ..syncer import start_syncer
 
-    from_server, token = args.from_server, ""
+    from_server, token, from_ca = args.from_server, "", None
     if from_server is None:
         if not args.from_kubeconfig:
             raise SystemExit("one of --from-server / -from_kubeconfig required")
         with open(args.from_kubeconfig, encoding="utf-8") as f:
-            from_server, token = kubeconfig_credentials(f.read())
-    upstream = RestClient(from_server, cluster=args.from_cluster, token=token)
-    downstream = RestClient(args.to_server, cluster=args.to_cluster)
+            from_server, token, from_ca = kubeconfig_credentials(f.read())
+    upstream = RestClient(from_server, cluster=args.from_cluster, token=token,
+                          ca_data=from_ca,
+                          ca_file=getattr(args, "from_ca_file", None))
+    downstream = RestClient(args.to_server, cluster=args.to_cluster,
+                            ca_file=getattr(args, "to_ca_file", None))
     mesh = None
     if getattr(args, "mesh", ""):
         from ..parallel.mesh import mesh_from_spec

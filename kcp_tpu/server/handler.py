@@ -435,20 +435,29 @@ class RestHandler:
         return StreamResponse(produce)
 
 
-def render_kubeconfig(address: str, path: str, token: str = "") -> None:
+def render_kubeconfig(address: str, path: str, token: str = "",
+                      ca_pem: bytes | None = None) -> None:
     """Write an admin kubeconfig-style file with admin + user contexts.
 
     Mirrors the reference writing .kcp/admin.kubeconfig with contexts
     ``admin`` and ``user`` (the latter scoped to /clusters/user)
     (reference: pkg/server/server.go:151-176). When RBAC-lite is on,
-    the minted admin bearer token rides along as the user credential.
-    """
+    the minted admin bearer token rides along as the user credential;
+    with TLS, the CA certificate rides as certificate-authority-data so
+    clients verify the self-signed endpoint."""
     users = [{"name": "admin", "user": ({"token": token} if token else {})}]
+    cluster_fields = {}
+    if ca_pem is not None:
+        import base64
+
+        cluster_fields["certificate-authority-data"] = base64.b64encode(
+            ca_pem).decode("ascii")
     cfg = {
         "kind": "Config", "apiVersion": "v1",
         "clusters": [
-            {"name": "admin", "cluster": {"server": address}},
-            {"name": "user", "cluster": {"server": f"{address}/clusters/user"}},
+            {"name": "admin", "cluster": {"server": address, **cluster_fields}},
+            {"name": "user", "cluster": {"server": f"{address}/clusters/user",
+                                         **cluster_fields}},
         ],
         "users": users,
         "contexts": [

@@ -2,9 +2,10 @@
 
 Only what the API surface needs: request-line + header parsing,
 Content-Length bodies, one-shot JSON responses, and chunked streaming
-responses for watches. No TLS (the reference's self-signed-cert etcd/
-serving setup, pkg/etcd/etcd.go:98-188, is an operational concern that a
-fronting proxy covers here; the wire protocol is the interesting part).
+responses for watches. TLS via an ``ssl.SSLContext`` (the server's
+self-signed serving certs, kcp_tpu/server/certs.py — parity with the
+reference's generated-cert TLS endpoint, pkg/etcd/etcd.go:98-188 +
+pkg/server/server.go:151-176).
 """
 
 from __future__ import annotations
@@ -106,17 +107,21 @@ Handler = Callable[[Request], Awaitable["Response | StreamResponse"]]
 class HttpServer:
     """asyncio.start_server wrapper dispatching to a single handler."""
 
-    def __init__(self, handler: Handler, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, handler: Handler, host: str = "127.0.0.1", port: int = 0,
+                 ssl_context=None):
         self.handler = handler
         self.host = host
         self.port = port
+        self.ssl_context = ssl_context
         self._server: asyncio.AbstractServer | None = None
         self._conns: set[asyncio.Task] = set()
 
     async def start(self) -> None:
-        self._server = await asyncio.start_server(self._serve, self.host, self.port)
+        self._server = await asyncio.start_server(
+            self._serve, self.host, self.port, ssl=self.ssl_context)
         self.port = self._server.sockets[0].getsockname()[1]
-        log.info("http server listening on %s:%d", self.host, self.port)
+        log.info("http%s server listening on %s:%d",
+                 "s" if self.ssl_context else "", self.host, self.port)
 
     async def stop(self) -> None:
         if self._server is not None:
@@ -131,7 +136,8 @@ class HttpServer:
 
     @property
     def address(self) -> str:
-        return f"http://{self.host}:{self.port}"
+        scheme = "https" if self.ssl_context else "http"
+        return f"{scheme}://{self.host}:{self.port}"
 
     async def _serve(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
         task = asyncio.current_task()
@@ -188,11 +194,23 @@ class HttpServer:
                     break
         except (ConnectionError, asyncio.IncompleteReadError, asyncio.LimitOverrunError):
             pass
+        except asyncio.CancelledError:
+            # server stop cancelled this connection task: a graceful TLS
+            # close would block on the peer's close_notify until the SSL
+            # shutdown timeout (observed: 30s per idle keep-alive conn) —
+            # abort the transport so stop() returns promptly
+            transport = writer.transport
+            if transport is not None:
+                transport.abort()
+            raise
         finally:
             try:
+                # graceful close: unbounded, so large in-flight responses
+                # to slow readers always flush fully
                 writer.close()
                 await writer.wait_closed()
-            except (ConnectionError, RuntimeError):
+            except (ConnectionError, RuntimeError, TimeoutError,
+                    asyncio.CancelledError):
                 pass
 
     async def _read_request(self, reader: asyncio.StreamReader) -> Request | None:

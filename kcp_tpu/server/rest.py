@@ -59,11 +59,12 @@ class RestWatch:
     """
 
     def __init__(self, host: str, port: int, path: str, resource: str,
-                 token: str = ""):
+                 token: str = "", ssl_context=None):
         self._host = host
         self._port = port
         self._path = path
         self._token = token
+        self._ssl = ssl_context
         self.resource = resource
         self._events: asyncio.Queue[Event | None] = asyncio.Queue()
         self._task: asyncio.Task | None = None
@@ -78,7 +79,9 @@ class RestWatch:
     async def _run(self) -> None:
         reader = writer = None
         try:
-            reader, writer = await asyncio.open_connection(self._host, self._port)
+            reader, writer = await asyncio.open_connection(
+                self._host, self._port, ssl=self._ssl,
+                server_hostname=self._host if self._ssl else None)
             auth = (f"Authorization: Bearer {self._token}\r\n"
                     if self._token else "")
             writer.write(
@@ -218,20 +221,32 @@ class RestClient:
     """HTTP twin of :class:`kcp_tpu.client.Client`."""
 
     def __init__(self, base_url: str, cluster: str = "admin",
-                 scheme: Scheme | None = None, token: str = ""):
+                 scheme: Scheme | None = None, token: str = "",
+                 ca_data: bytes | str | None = None,
+                 ca_file: str | None = None):
         parts = urlsplit(base_url)
         self._host = parts.hostname or "127.0.0.1"
-        self._port = parts.port or 80
+        self._tls = parts.scheme == "https"
+        self._port = parts.port or (443 if self._tls else 80)
         self.base_url = base_url.rstrip("/")
         self.cluster = cluster
         self.scheme = scheme if scheme is not None else default_scheme()
         self.token = token  # bearer credential (RBAC-lite servers)
+        self.ca_data = ca_data  # PEM trust anchor for the server's CA
+        self.ca_file = ca_file
+        self._ssl = None
+        if self._tls:
+            from .certs import client_context
+
+            self._ssl = client_context(ca_data, ca_file)
         self._discovered: dict[str, ResourceInfo] = {}
         self._conn: http.client.HTTPConnection | None = None
 
     def scoped(self, cluster: str) -> "RestClient":
-        c = RestClient(self.base_url, cluster, self.scheme, token=self.token)
-        c._discovered = self._discovered
+        c = RestClient.__new__(RestClient)
+        c.__dict__.update(self.__dict__)
+        c.cluster = cluster
+        c._conn = None  # connections are per-instance; ssl ctx is shared
         return c
 
     # ------------------------------------------------------------ plumbing
@@ -253,8 +268,12 @@ class RestClient:
         for attempt in (0, 1):
             reused = self._conn is not None
             if self._conn is None:
-                self._conn = http.client.HTTPConnection(
-                    self._host, self._port, timeout=30)
+                if self._tls:
+                    self._conn = http.client.HTTPSConnection(
+                        self._host, self._port, timeout=30, context=self._ssl)
+                else:
+                    self._conn = http.client.HTTPConnection(
+                        self._host, self._port, timeout=30)
             try:
                 self._conn.request(method, path, body=payload, headers=headers)
             except (ConnectionError, http.client.HTTPException, OSError):
@@ -362,7 +381,8 @@ class RestClient:
         if since_rv is not None:
             query += f"&resourceVersion={since_rv}"
         path = self._path(res, namespace, query=query)
-        return RestWatch(self._host, self._port, path, res, token=self.token)
+        return RestWatch(self._host, self._port, path, res, token=self.token,
+                         ssl_context=self._ssl)
 
     # ------------------------------------------------------------- writes
 
@@ -421,8 +441,11 @@ class RestClient:
 class MultiClusterRestClient(RestClient):
     """Wildcard RestClient (EnableMultiCluster analog over the wire)."""
 
-    def __init__(self, base_url: str, scheme: Scheme | None = None):
-        super().__init__(base_url, WILDCARD, scheme)
+    def __init__(self, base_url: str, scheme: Scheme | None = None,
+                 token: str = "", ca_data: bytes | str | None = None,
+                 ca_file: str | None = None):
+        super().__init__(base_url, WILDCARD, scheme, token=token,
+                         ca_data=ca_data, ca_file=ca_file)
 
     def cluster_client(self, cluster: str) -> RestClient:
         return self.scoped(cluster)

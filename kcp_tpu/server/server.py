@@ -42,6 +42,9 @@ class Config:
     authz: bool = False  # RBAC-lite enforcement (server/authz.py); the
     # reference prototype runs open, so open stays the default
     admin_token: str = ""  # minted when empty and authz is on
+    tls: bool = True  # serve HTTPS with self-generated certs (reference
+    # parity: pkg/etcd/etcd.go:98-188 + server.go:151-176); certs persist
+    # under root_dir/pki for durable servers, ephemeral otherwise
     mesh: str = ""  # serving-mesh spec ("8", "4x2", "2x2x2"): shard the
     # fused reconcile core's buckets over a jax device mesh (SURVEY §7.2
     # step 9; the reference's horizontal-sharding story,
@@ -79,8 +82,19 @@ class Server:
         self.authenticator = authn
         self.handler = RestHandler(self.store, self.scheme,
                                    authenticator=authn, authorizer=authz)
+        self.certs = None
+        ssl_context = None
+        if self.config.tls:
+            from .certs import ServingCerts
+
+            cert_dir = (os.path.join(self.config.root_dir, "pki")
+                        if self.config.durable else None)
+            hosts = {self.config.listen_host, "127.0.0.1", "localhost"}
+            self.certs = ServingCerts.load_or_create(cert_dir, sorted(hosts))
+            ssl_context = self.certs.server_context()
         self.http = HttpServer(self.handler, self.config.listen_host,
-                               self.config.listen_port)
+                               self.config.listen_port,
+                               ssl_context=ssl_context)
         self.client = MultiClusterClient(self.store)
         self._controllers: list = []
         self._post_start_hooks: list = []
@@ -94,13 +108,20 @@ class Server:
     def address(self) -> str:
         return self.http.address
 
+    @property
+    def ca_pem(self) -> bytes | None:
+        """The serving CA certificate (None when TLS is off) — what a
+        client passes as ``RestClient(..., ca_data=...)``."""
+        return self.certs.ca_cert_pem if self.certs else None
+
     async def start(self) -> None:
         """Bring the server up and fire hooks; returns once serving."""
         await self.http.start()
         if self.config.durable:
             render_kubeconfig(self.address,
                               os.path.join(self.config.root_dir, "admin.kubeconfig"),
-                              token=self.config.admin_token)
+                              token=self.config.admin_token,
+                              ca_pem=self.certs.ca_cert_pem if self.certs else None)
         if self.config.install_controllers:
             await self._install_controllers()
         for hook in self._post_start_hooks:

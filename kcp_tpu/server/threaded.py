@@ -57,6 +57,11 @@ class ServerThread:
             self._loop.close()
             self._started.set()  # unblock start() on failure paths
 
+    @property
+    def ca_pem(self) -> bytes | None:
+        """The serving CA (None when TLS off) for RestClient(ca_data=...)."""
+        return self.server.ca_pem if self.server else None
+
     def submit(self, coro):
         """Run a coroutine on the server loop, return its result."""
         assert self._loop is not None

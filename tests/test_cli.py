@@ -89,14 +89,18 @@ def test_crd_puller_cli(tmp_path, capsys):
     from kcp_tpu.server.threaded import ServerThread
 
     with ServerThread(Config(durable=False, install_controllers=False)) as st:
+        ca = tmp_path / "ca.crt"
+        ca.write_bytes(st.ca_pem)
         rc = puller_cli.main(["--server", st.address, "--cluster", "default",
+                              "--ca-file", str(ca),
                               "--out-dir", str(tmp_path), "deployments.apps"])
         assert rc == 0
         out = yaml.safe_load((tmp_path / "deployments.apps.yaml").read_text())
         assert out["kind"] == "CustomResourceDefinition"
         assert out["spec"]["group"] == "apps"
 
-        rc = puller_cli.main(["--server", st.address, "--out-dir", str(tmp_path),
+        rc = puller_cli.main(["--server", st.address, "--ca-file", str(ca),
+                              "--out-dir", str(tmp_path),
                               "nonexistent.fake.group"])
         assert rc == 1
 
@@ -104,7 +108,8 @@ def test_crd_puller_cli(tmp_path, capsys):
 def _start_kcp(tmp_path, env, name):
     proc = subprocess.Popen(
         [sys.executable, "-m", "kcp_tpu.cli.kcp", "start",
-         "--in-memory", "--no-install-controllers", "--listen-port", "0"],
+         "--in-memory", "--no-tls", "--no-install-controllers",
+         "--listen-port", "0"],
         cwd=str(tmp_path), env=env,
         stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
     line = proc.stdout.readline()
@@ -174,12 +179,14 @@ def test_kcp_start_subprocess(tmp_path):
                PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
     proc = subprocess.Popen(
         [sys.executable, "-m", "kcp_tpu.cli.kcp", "start",
-         "--in-memory", "--no-install-controllers", "--listen-port", "0"],
+         "--in-memory", "--no-tls", "--no-install-controllers",
+         "--listen-port", "0"],
         cwd=str(tmp_path), env=env,
         stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
     try:
         line = proc.stdout.readline()
         assert "serving at" in line, line
+        assert line.strip().rsplit(" ", 1)[-1].startswith("http://")
         base = line.strip().rsplit(" ", 1)[-1]
 
         body = json.dumps({"metadata": {"name": "sub"}, "data": {"a": "1"}}).encode()

@@ -285,8 +285,7 @@ def test_rest_serves_openapi_from_published_crds():
     from kcp_tpu.server.threaded import ServerThread
 
     with ServerThread(Config(durable=False, install_controllers=False)) as st:
-        port = st.server.http.port
-        rc = RestClient(f"http://127.0.0.1:{port}", "admin")
+        rc = RestClient(st.address, "admin", ca_data=st.ca_pem)
         rc.create(crdapi.CRDS, crdapi.new_crd(
             group="example.dev", version="v1", plural="widgets",
             kind="Widget", schema={

@@ -29,8 +29,16 @@ def srv():
         yield st
 
 
+def _conn(st):
+    from kcp_tpu.server.certs import client_context
+
+    return http.client.HTTPSConnection(
+        "127.0.0.1", st.server.http.port, timeout=10,
+        context=client_context(st.server.ca_pem))
+
+
 def raw_request(st, method, path, body=None):
-    conn = http.client.HTTPConnection("127.0.0.1", st.server.http.port, timeout=10)
+    conn = _conn(st)
     try:
         payload = json.dumps(body).encode() if body is not None else None
         conn.request(method, path, body=payload)
@@ -159,7 +167,7 @@ def test_unknown_resource_404(srv):
 
 def test_client_errors_are_4xx(srv):
     # malformed JSON body → 400, not 500
-    conn = http.client.HTTPConnection("127.0.0.1", srv.server.http.port, timeout=10)
+    conn = _conn(srv)
     conn.request("POST", "/clusters/t/api/v1/configmaps", body=b"not json")
     resp = conn.getresponse()
     assert resp.status == 400
@@ -186,13 +194,13 @@ def test_rest_watch_unknown_resource_raises(srv):
     """A watch on an unserved resource surfaces NotFound, not silence."""
 
     async def main():
-        w = RestClient(srv.address, cluster="t")
+        w = RestClient(srv.address, ca_data=srv.ca_pem, cluster="t")
         from kcp_tpu.apis.scheme import GVR, ResourceInfo, Scheme
 
         sch = Scheme()
         sch.register(ResourceInfo(GVR("ghost.dev", "v1", "ghosts"), "Ghost",
                                   "GhostList", "ghost", True))
-        watch = RestClient(srv.address, cluster="t", scheme=sch).watch("ghosts.ghost.dev")
+        watch = RestClient(srv.address, ca_data=srv.ca_pem, cluster="t", scheme=sch).watch("ghosts.ghost.dev")
         with pytest.raises(errors.NotFoundError):
             async for _ in watch:
                 pass
@@ -215,7 +223,11 @@ def test_watch_stream_over_http(srv):
 
     async def main():
         port = srv.server.http.port
-        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        from kcp_tpu.server.certs import client_context
+
+        reader, writer = await asyncio.open_connection(
+            "127.0.0.1", port, ssl=client_context(srv.server.ca_pem),
+            server_hostname="127.0.0.1")
         writer.write(
             b"GET /clusters/t/api/v1/configmaps?watch=true HTTP/1.1\r\n"
             b"Host: x\r\nConnection: close\r\n\r\n")
@@ -241,7 +253,7 @@ def test_watch_stream_over_http(srv):
 
 
 def test_rest_client_crud(srv):
-    c = RestClient(srv.address, cluster="alpha")
+    c = RestClient(srv.address, ca_data=srv.ca_pem, cluster="alpha")
     created = c.create("configmaps", cm("rc", {"v": "1"}))
     assert created["metadata"]["clusterName"] == "alpha"
 
@@ -273,7 +285,7 @@ def test_rest_client_discovery_of_dynamic_resource(srv):
     srv.call(srv.server.scheme.register, ResourceInfo(
         gvr=GVR("widgets.example.dev", "v1", "widgets"), kind="Widget",
         list_kind="WidgetList", singular="widget", namespaced=True))
-    c = RestClient(srv.address, cluster="t", scheme=Scheme())
+    c = RestClient(srv.address, ca_data=srv.ca_pem, cluster="t", scheme=Scheme())
     obj = c.create("widgets.widgets.example.dev",
                    {"metadata": {"name": "w", "namespace": "ns1"}, "spec": {"n": 1}})
     assert obj["kind"] == "Widget"
@@ -284,7 +296,7 @@ def test_informer_over_rest_watch(srv):
     """The shared Informer runs unchanged over the HTTP watch stream."""
 
     async def main():
-        mc = MultiClusterRestClient(srv.address)
+        mc = MultiClusterRestClient(srv.address, ca_data=srv.ca_pem)
         inf = Informer(mc, "configmaps")
         seen = []
         inf.add_handler(
@@ -324,7 +336,7 @@ def test_watch_window_expired_gone(srv):
                 "/clusters/t/api/v1/namespaces/default/configmaps", cm("last", {}))
 
     async def main():
-        w = RestClient(srv.address, cluster="t").watch("configmaps", since_rv=1)
+        w = RestClient(srv.address, ca_data=srv.ca_pem, cluster="t").watch("configmaps", since_rv=1)
         with pytest.raises(errors.ConflictError):
             await w.next_batch(max_wait=2.0)
         assert w.closed
@@ -352,10 +364,10 @@ def test_informer_reconnects_after_server_restart(tmp_path):
                      install_controllers=False, listen_port=0)
         st = ServerThread(cfg).start()
         port = st.server.http.port
-        c = RestClient(st.address, cluster="t")
+        c = RestClient(st.address, ca_data=st.ca_pem, cluster="t")
         c.create("configmaps", cm("before", {"k": "1"}))
 
-        inf = Informer(MultiClusterRestClient(st.address), "configmaps")
+        inf = Informer(MultiClusterRestClient(st.address, ca_data=st.ca_pem), "configmaps")
         inf.rewatch_backoff = 0.05
         await inf.start()
         await inf.wait_synced()
@@ -368,7 +380,7 @@ def test_informer_reconnects_after_server_restart(tmp_path):
                                   install_controllers=False,
                                   listen_port=port)).start()
         try:
-            RestClient(st2.address, cluster="t").create(
+            RestClient(st2.address, ca_data=st2.ca_pem, cluster="t").create(
                 "configmaps", cm("after", {"k": "2"}))
             for _ in range(200):
                 if inf.get("t", "after", "default") is not None:
@@ -389,11 +401,11 @@ def test_informer_reconnects_after_server_restart(tmp_path):
 def test_server_durable_restart(tmp_path):
     cfg = Config(root_dir=str(tmp_path), durable=True, install_controllers=False)
     with ServerThread(cfg) as st:
-        c = RestClient(st.address, cluster="t")
+        c = RestClient(st.address, ca_data=st.ca_pem, cluster="t")
         c.create("configmaps", cm("persist", {"k": "v"}))
         assert (tmp_path / "admin.kubeconfig").exists()
 
     with ServerThread(Config(root_dir=str(tmp_path), durable=True,
                              install_controllers=False)) as st2:
-        got = RestClient(st2.address, cluster="t").get("configmaps", "persist", "default")
+        got = RestClient(st2.address, ca_data=st2.ca_pem, cluster="t").get("configmaps", "persist", "default")
         assert got["data"] == {"k": "v"}

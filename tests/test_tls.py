@@ -1,0 +1,144 @@
+"""TLS end to end: generated certs, HTTPS serving, verifying clients.
+
+Reference parity: the reference self-generates ECDSA certs at startup
+(pkg/etcd/etcd.go:98-188), serves TLS :6443, and writes a kubeconfig
+with credentials for the secure endpoint (pkg/server/server.go:151-176).
+These tests pin the kcp-tpu equivalents: ServingCerts, the HTTPS
+endpoint, CA-verifying RestClient/watch streams, kubeconfig
+certificate-authority-data round-trips (the pull-mode pod's credential
+path), CA stability across durable restarts, and the security
+properties (no CA -> verification fails; TLS is the default).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import ssl
+import subprocess
+import sys
+import urllib.request
+
+import pytest
+
+from kcp_tpu.cli.syncer import kubeconfig_credentials
+from kcp_tpu.server import Config, RestClient
+from kcp_tpu.server.certs import client_context
+from kcp_tpu.server.handler import render_kubeconfig
+from kcp_tpu.server.threaded import ServerThread
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def cm(name, data):
+    return {"apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": name, "namespace": "default"}, "data": data}
+
+
+def test_tls_is_the_default_and_verified_crud_works():
+    with ServerThread(Config(durable=False, install_controllers=False)) as st:
+        assert st.address.startswith("https://")
+        c = RestClient(st.address, cluster="t", ca_data=st.ca_pem)
+        c.create("configmaps", cm("a", {"k": "v"}), namespace="default")
+        assert c.get("configmaps", "a", "default")["data"] == {"k": "v"}
+
+
+def test_client_without_ca_is_rejected():
+    """The security property three rounds asked for: the endpoint is not
+    plaintext and is not trusted without the CA."""
+    with ServerThread(Config(durable=False, install_controllers=False)) as st:
+        c = RestClient(st.address, cluster="t")  # system trust store only
+        with pytest.raises(ssl.SSLCertVerificationError):
+            c.create("configmaps", cm("x", {}), namespace="default")
+
+
+def test_watch_stream_over_tls():
+    with ServerThread(Config(durable=False, install_controllers=False)) as st:
+        async def main():
+            c = RestClient(st.address, cluster="t", ca_data=st.ca_pem)
+            await asyncio.to_thread(
+                c.create, "configmaps", cm("w", {"x": "1"}), "default")
+            # since_rv=0 replays history (events with rv > 0), so the
+            # event is seen regardless of when the TLS stream connects
+            watch = c.watch("configmaps", since_rv=0)
+            async for ev in watch:
+                assert ev.object["metadata"]["name"] == "w"
+                break
+            watch.close()
+
+        asyncio.run(main())
+
+
+def test_kubeconfig_carries_ca_and_round_trips(tmp_path):
+    """render_kubeconfig -> kubeconfig_credentials -> verified RestClient:
+    the exact credential path a pull-mode syncer pod walks."""
+    with ServerThread(Config(durable=False, install_controllers=False)) as st:
+        path = tmp_path / "admin.kubeconfig"
+        render_kubeconfig(st.address, str(path), token="tok-1",
+                          ca_pem=st.ca_pem)
+        server, token, ca = kubeconfig_credentials(path.read_text())
+        assert server == st.address
+        assert token == "tok-1"
+        assert ca == st.ca_pem
+        c = RestClient(server, cluster="t", token=token, ca_data=ca)
+        c.create("configmaps", cm("kc", {"via": "kubeconfig"}),
+                 namespace="default")
+        assert c.get("configmaps", "kc", "default")["data"] == {
+            "via": "kubeconfig"}
+
+
+def test_ca_stable_across_durable_restart(tmp_path):
+    """Restart keeps the CA (pki/ dir), so issued kubeconfigs stay valid."""
+    cfg = dict(root_dir=str(tmp_path), durable=True, install_controllers=False)
+    with ServerThread(Config(**cfg)) as st:
+        ca1 = st.ca_pem
+        RestClient(st.address, cluster="t", ca_data=ca1).create(
+            "configmaps", cm("p", {"n": "1"}), namespace="default")
+    with ServerThread(Config(**cfg)) as st2:
+        assert st2.ca_pem == ca1
+        got = RestClient(st2.address, cluster="t", ca_data=ca1).get(
+            "configmaps", "p", "default")
+        assert got["data"] == {"n": "1"}
+        kc = json.loads((tmp_path / "admin.kubeconfig").read_text())
+        assert kc["clusters"][0]["cluster"]["certificate-authority-data"]
+
+
+def test_kcp_start_serves_tls_by_default(tmp_path):
+    """`kcp start` (durable) serves HTTPS; pki/ca.crt + admin.kubeconfig
+    let an external client do verified CRUD — server.go:151-176 parity."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kcp_tpu.cli.kcp", "start",
+         "--no-install-controllers", "--listen-port", "0",
+         "--root-dir", str(tmp_path / "kcp")],
+        cwd=str(tmp_path), env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+    try:
+        line = proc.stdout.readline()
+        assert "serving at" in line, line
+        base = line.strip().rsplit(" ", 1)[-1]
+        assert base.startswith("https://")
+
+        ca_file = tmp_path / "kcp" / "pki" / "ca.crt"
+        assert ca_file.exists()
+        ctx = client_context(ca_file.read_bytes())
+        body = json.dumps(cm("tls", {"a": "1"})).encode()
+        req = urllib.request.Request(
+            f"{base}/clusters/t/api/v1/namespaces/default/configmaps",
+            data=body, method="POST")
+        with urllib.request.urlopen(req, timeout=10, context=ctx) as resp:
+            assert resp.status == 201
+
+        # the written kubeconfig's CA verifies too (what kubectl would use)
+        kc = (tmp_path / "kcp" / "admin.kubeconfig").read_text()
+        server, _tok, ca = kubeconfig_credentials(kc)
+        got = RestClient(server, cluster="t", ca_data=ca).get(
+            "configmaps", "tls", "default")
+        assert got["data"] == {"a": "1"}
+    finally:
+        import signal
+
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=15) == 0
