@@ -145,24 +145,32 @@ class SchemaPuller:
     def _synthesize(self, gvr: GVR, doc: dict | None) -> dict | None:
         """Discovery -> synthesized CRD (discovery.go:176-287).
 
-        Schema source fallback chain: the curated known-schema table
-        (the resource-level ``knownPackages`` analog — curated schemas
-        override whatever discovery serves, as the reference's known
-        tables do, discovery.go:481-569), then the cluster's
-        ``/openapi/v2`` document (SchemaConverter analog,
-        :mod:`.openapi`), then preserve-unknown.
+        Schema source precedence matches the reference: the cluster's
+        LIVE ``/openapi/v2`` document wins (SchemaConverter analog,
+        :mod:`.openapi` — its known-ref tables override meta-type $refs
+        INSIDE the conversion, discovery.go:481-569), then the curated
+        resource-level table (for clusters serving no usable openapi),
+        then preserve-unknown. A physical cluster's actual schema for a
+        well-known resource name must be importable — the curated table
+        is a fallback, not a shadow.
         """
         info = self.physical.scheme.by_resource(gvr.storage_name)
         if info is None or gvr.storage_name not in self.physical.resources():
             return None
-        schema = None
-        if gvr.resource in KNOWN_SCHEMAS:
+        schema = self._from_openapi(info, doc)
+        if schema is None and gvr.resource in KNOWN_SCHEMAS:
             schema = copy.deepcopy(KNOWN_SCHEMAS[gvr.resource])
-        if schema is None:
-            schema = self._from_openapi(info, doc)
         if schema is None:
             schema = copy.deepcopy(_OBJECT_PRESERVE)
         has_status = "status" in (schema.get("properties") or {})
+        if not has_status and gvr.resource in KNOWN_SCHEMAS:
+            # the reference derives the status subresource from discovery
+            # (discovery.go:214-224); our discovery surface has no
+            # per-subresource signal, so well-known resources keep their
+            # curated status guarantee even when the live openapi
+            # definition omits the property
+            has_status = "status" in (
+                KNOWN_SCHEMAS[gvr.resource].get("properties") or {})
         return crdapi.new_crd(
             group=info.gvr.group,
             version=info.gvr.version,

@@ -187,20 +187,40 @@ def test_crd_roundtrip_preserves_k8s_extensions():
     assert errs == []
 
 
-def test_known_schemas_take_precedence_over_openapi():
-    """Curated KNOWN_SCHEMAS override whatever the swagger doc serves
-    (the resource-level knownPackages analog, discovery.go:481-569)."""
-    from kcp_tpu.crdpuller.puller import KNOWN_SCHEMAS
-
+def test_live_openapi_takes_precedence_over_known_schemas():
+    """Reference precedence (discovery.go:176-287): the cluster's LIVE
+    openapi document wins even for well-known resource names; the
+    curated table is a fallback, not a shadow — a physical cluster's
+    actual Deployment schema must be importable."""
     registry = PhysicalRegistry()
     phys = registry.resolve("fake://east")
     registry.fake_store("east").openapi_doc = {"definitions": {
         "io.k8s.api.apps.v1.Deployment": {
-            "type": "object", "properties": {"bogus": {"type": "string"}},
+            "type": "object",
+            "properties": {"clusterSpecific": {"type": "string"}},
             "x-kubernetes-group-version-kind": [
                 {"group": "apps", "version": "v1", "kind": "Deployment"}],
         },
     }}
+    crd = SchemaPuller(phys).pull_crds(["deployments.apps"])["deployments.apps"]
+    version = crd["spec"]["versions"][0]
+    schema = version["schema"]["openAPIV3Schema"]
+    assert "clusterSpecific" in schema["properties"]
+    # the live definition omits 'status', but a well-known resource keeps
+    # its curated status-subresource guarantee (the reference gets this
+    # from discovery, discovery.go:214-224)
+    assert "status" in version["subresources"]
+
+
+def test_known_schemas_fill_in_when_openapi_lacks_the_type():
+    """No usable openapi definition -> the curated table still gives
+    well-known resources a real schema (knownPackages fallback,
+    discovery.go:481-569)."""
+    from kcp_tpu.crdpuller.puller import KNOWN_SCHEMAS
+
+    registry = PhysicalRegistry()
+    phys = registry.resolve("fake://east")
+    registry.fake_store("east").openapi_doc = {"definitions": {}}
     crd = SchemaPuller(phys).pull_crds(["deployments.apps"])["deployments.apps"]
     schema = crd["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
     assert schema == KNOWN_SCHEMAS["deployments"]
