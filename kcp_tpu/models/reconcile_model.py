@@ -191,12 +191,24 @@ reconcile_step_jit = jax.jit(
 # makes a tick exactly one upload and one download regardless of lane
 # count. Patch entries carry row index (20 bits), decision code (2 bits,
 # bit 20-21) and the status-upsync flag (bit 23).
+#
+# Wire layout (int32):
+#   [0]                 patch count
+#   [1]                 patch overflow flag
+#   [2:10]              stats
+#   [10]                placement-dirty count
+#   [PACK_HDR : +K]     packed patch entries (K = patch_capacity)
+#   [PACK_HDR+K : +R*(1+P)]  placement entries: R rows of
+#                       (root row index or R for padding, P leaf counts)
+#                       — dirty roots compacted first (the splitter lane
+#                       rides the same wire as the sync lanes)
 # ---------------------------------------------------------------------------
 
 PACK_HDR = 16  # int32 slots ahead of the packed patch entries
 PACK_IDX_MASK = (1 << 20) - 1
 PACK_CODE_SHIFT = 20
 PACK_UPSYNC_BIT = 1 << 23
+PACK_PLACEMENT_COUNT = 10  # hdr slot carrying the placement-dirty count
 
 
 def pack_deltas(deltas: ReconcileDeltas) -> np.ndarray:
@@ -251,11 +263,23 @@ def reconcile_step_packed(state: ReconcileState, packed: jax.Array,
         | (out.patch_code.astype(jnp.int32) << PACK_CODE_SHIFT)
         | jnp.where(out.patch_upsync, PACK_UPSYNC_BIT, 0)
     )
+    # placement segment: dirty roots compacted first, each carrying its
+    # P leaf counts (the deployment splitter's serving lane)
+    r = state.replicas.shape[0]
+    dirty = out.placement_dirty
+    (pidx,) = jnp.nonzero(dirty, size=r, fill_value=r)
+    safe = jnp.minimum(pidx, r - 1)
+    valid = pidx < r
+    counts = jnp.where(valid[:, None], out.leaf_replicas[safe], 0)
+    pl_entries = jnp.concatenate(
+        [pidx.astype(jnp.int32)[:, None], counts.astype(jnp.int32)], axis=1
+    ).reshape(-1)
     hdr = jnp.zeros(PACK_HDR, jnp.int32)
     hdr = hdr.at[0].set(out.patch_count)
     hdr = hdr.at[1].set(out.patch_overflow.astype(jnp.int32))
     hdr = hdr.at[2:10].set(out.stats)
-    return new_state, jnp.concatenate([hdr, entries])
+    hdr = hdr.at[PACK_PLACEMENT_COUNT].set(dirty.sum(dtype=jnp.int32))
+    return new_state, jnp.concatenate([hdr, entries, pl_entries])
 
 
 def unpack_patches(wire: np.ndarray) -> tuple[np.ndarray, np.ndarray, np.ndarray, bool, np.ndarray]:
@@ -269,6 +293,16 @@ def unpack_patches(wire: np.ndarray) -> tuple[np.ndarray, np.ndarray, np.ndarray
         bool(wire[1]),
         wire[2:10],
     )
+
+
+def unpack_placement(wire: np.ndarray, patch_capacity: int,
+                     p: int) -> tuple[np.ndarray, np.ndarray]:
+    """Host-side: (dirty root row indices [N], leaf counts [N, P]) from
+    the wire's placement segment (the caller knows the bucket's static
+    patch_capacity and cluster width P)."""
+    n = int(wire[PACK_PLACEMENT_COUNT])
+    seg = wire[PACK_HDR + patch_capacity:].reshape(-1, 1 + p)
+    return seg[:n, 0], seg[:n, 1:]
 
 
 def example_state(

@@ -35,7 +35,7 @@ from ...apis.cluster import CLUSTERS
 from ...apis.scheme import GVR
 from ...client import Client, Informer
 from ...ops.encode import pad_pow2
-from ...ops.placement import aggregate_status_jit, split_replicas_jit
+from ...ops.placement import aggregate_status_jit
 from ...reconciler.controller import BatchController
 from ...utils import errors
 
@@ -71,9 +71,13 @@ class DeploymentSplitter:
         backend: str = "tpu",
         rebalance: bool = False,
         max_pclusters: int = 8,
+        core=None,
     ):
         self.client = client
         self.backend = backend
+        self.fused = backend == "tpu"
+        self.core = core  # FusedCore (tpu backend; lazily bound at start)
+        self._pbucket = None
         self.rebalance = rebalance
         self.max_pclusters = max_pclusters
         self.informer = Informer(client, DEPLOYMENTS)
@@ -87,7 +91,16 @@ class DeploymentSplitter:
         )
         self.informer.add_handler(self._on_event)
         self.cluster_informer.add_handler(self._on_cluster_event)
-        self.stats = {"ticks": 0, "splits": 0, "aggregations": 0}
+        # fused bookkeeping: staged cluster-count per root (stale-apply
+        # detection) and device counts awaiting a successful apply
+        self._staged_n: dict[tuple[str, str, str], int] = {}
+        self._retry_counts: dict[tuple[str, str, str], np.ndarray] = {}
+        # applier pool: placement_apply is called from the core's tick
+        # loop and must not block it (the SectionOwner contract)
+        self._apply_q: "asyncio.Queue | None" = None
+        self._apply_tasks: list = []
+        self.stats = {"ticks": 0, "splits": 0, "aggregations": 0,
+                      "fused_placements": 0}
 
     @staticmethod
     def _owned_by_index(obj: dict) -> list[str]:
@@ -138,11 +151,16 @@ class DeploymentSplitter:
         failed: list[tuple[object, Exception]] = []
         failed_keys = set()
 
-        # ---- placement lane: batch all roots through the device kernel
+        # ---- placement lane
         plan_rows = []
         for key in roots:
             root = self.informer.cache.get(key)
             if root is None or not is_root(root):
+                if self.fused and self._pbucket is not None:
+                    # root gone: retire its placement row
+                    self._pbucket.free_pl_row(key)
+                    self._staged_n.pop(key, None)
+                    self._retry_counts.pop(key, None)
                 continue
             leafs = self.informer.index("owned_by", "/".join(key))
             if leafs and not self.rebalance:
@@ -150,7 +168,43 @@ class DeploymentSplitter:
             clusters = self._clusters_for(key[0])
             plan_rows.append((key, root, clusters, leafs))
 
-        if plan_rows:
+        if plan_rows and self.fused and self._pbucket is not None:
+            # SERVED path: roots ride the FusedCore's placement lanes —
+            # the same fused step that serves the sync sections computes
+            # the split, and dirty rows come back via placement_apply
+            kicked = False
+            for key, root, clusters, leafs in plan_rows:
+                if not clusters:
+                    # NoRegisteredClusters is pure host-side status
+                    try:
+                        self._apply_placement(key, root, clusters, leafs, None)
+                    except Exception as err:  # noqa: BLE001
+                        failed_keys.add(("root", key))
+                        failed.append((("root", key), err))
+                    continue
+                retry = self._retry_counts.pop(key, None)
+                if (retry is not None
+                        and len(clusters) == self._staged_n.get(key)
+                        and not self._counts_stale(root, retry)):
+                    # a device-computed split failed to apply earlier;
+                    # re-apply from the cached counts (re-staging the
+                    # same inputs would not re-dirty the device row).
+                    # Stale cache (spec changed since) falls through to
+                    # a fresh staging instead.
+                    try:
+                        self._apply_placement(key, root, clusters, leafs, retry)
+                    except Exception as err:  # noqa: BLE001
+                        self._retry_counts[key] = retry
+                        failed_keys.add(("root", key))
+                        failed.append((("root", key), err))
+                    continue
+                replicas = root.get("spec", {}).get("replicas", 0) or 0
+                self._pbucket.stage_placement(key, int(replicas), len(clusters))
+                self._staged_n[key] = len(clusters)
+                kicked = True
+            if kicked:
+                self.core.kick(self._pbucket)
+        elif plan_rows:
             reps = np.array(
                 [r[1].get("spec", {}).get("replicas", 0) or 0 for r in plan_rows],
                 dtype=np.int32,
@@ -163,10 +217,7 @@ class DeploymentSplitter:
             avail = np.zeros((len(plan_rows), width), dtype=bool)
             for i, (_, _, clusters, _) in enumerate(plan_rows):
                 avail[i, : len(clusters)] = True
-            if self.backend == "tpu":
-                leaf_counts = np.asarray(split_replicas_jit(reps, avail))
-            else:
-                leaf_counts = self._host_split(reps, avail)
+            leaf_counts = self._host_split(reps, avail)
             for i, (key, root, clusters, leafs) in enumerate(plan_rows):
                 try:
                     self._apply_placement(key, root, clusters, leafs, leaf_counts[i])
@@ -210,6 +261,56 @@ class DeploymentSplitter:
                     failed_keys.add(("leaf", key))
                     failed.append((("leaf", key), err))
         return failed
+
+    # ------------------------------------------------- fused-core seam
+
+    def placement_apply(self, applies: list[tuple[tuple[str, str, str], np.ndarray]]) -> None:
+        """Dirty placement rows from a collected fused tick: hand off to
+        the applier pool — this runs on the core's tick loop and must not
+        block it (the SectionOwner contract, syncer/core.py)."""
+        for entry in applies:
+            self._apply_q.put_nowait(entry)
+
+    def _counts_stale(self, root: dict, counts: np.ndarray) -> bool:
+        """Device counts are provably for THESE inputs only when their
+        sum equals the root's current replicas (the split preserves the
+        sum). A mismatch means the row was re-used or the spec changed
+        while the wire was in flight — restage, never apply."""
+        want = int(root.get("spec", {}).get("replicas", 0) or 0)
+        return int(np.sum(counts)) != want
+
+    async def _apply_worker(self) -> None:
+        while True:
+            key, counts = await self._apply_q.get()
+            try:
+                self._apply_one_fused(key, counts)
+            except Exception:  # noqa: BLE001 — worker must survive
+                log.exception("deployment-splitter: fused apply crashed")
+            finally:
+                self._apply_q.task_done()
+
+    def _apply_one_fused(self, key, counts: np.ndarray) -> None:
+        root = self.informer.cache.get(key)
+        if root is None or not is_root(root):
+            return
+        clusters = self._clusters_for(key[0])
+        if len(clusters) != self._staged_n.get(key) or self._counts_stale(root, counts):
+            # the cluster set / spec / row assignment changed while the
+            # tick was in flight: restage with current inputs instead of
+            # applying stale counts
+            self.controller.enqueue(("root", key))
+            return
+        leafs = self.informer.index("owned_by", "/".join(key))
+        if leafs and not self.rebalance:
+            return
+        try:
+            self._apply_placement(key, root, clusters, leafs, counts)
+            self.stats["fused_placements"] += 1
+        except Exception as err:  # noqa: BLE001
+            log.info("deployment-splitter: fused placement apply for %r "
+                     "failed (%s); requeued", key, err)
+            self._retry_counts[key] = np.asarray(counts)
+            self.controller.queue.add_rate_limited(("root", key))
 
     @staticmethod
     def _host_split(reps: np.ndarray, avail: np.ndarray) -> np.ndarray:
@@ -311,11 +412,50 @@ class DeploymentSplitter:
     # ---------------------------------------------------------- lifecycle
 
     async def start(self) -> None:
+        import asyncio
+
+        if self.fused:
+            if self.core is None:
+                from ...syncer.core import FusedCore
+
+                self.core = FusedCore.for_current_loop()
+            self._pbucket = self.core.register_placement(
+                self, p=self.max_pclusters)
+            self._apply_q = asyncio.Queue()
+            for _ in range(2):
+                self._apply_tasks.append(
+                    asyncio.create_task(self._apply_worker()))
+            await self.core.start()
         await self.cluster_informer.start()
         await self.informer.start()
         await self.controller.start()
 
     async def stop(self) -> None:
+        import asyncio
+
         await self.controller.stop()
+        if self.fused and self.core is not None:
+            await self.core.stop()
+            # the core's shutdown drain may have enqueued final applies
+            if self._apply_q is not None:
+                try:
+                    await asyncio.wait_for(self._apply_q.join(), timeout=5.0)
+                except asyncio.TimeoutError:
+                    log.warning("deployment-splitter: applier queue not "
+                                "drained at stop")
+            for t in self._apply_tasks:
+                t.cancel()
+            for t in self._apply_tasks:
+                try:
+                    await t
+                except asyncio.CancelledError:
+                    pass
+            self._apply_tasks.clear()
+            if self._pbucket is not None:
+                for key in list(self._pbucket.pl_rows):
+                    self._pbucket.free_pl_row(key)
+                if self._pbucket.placement_owner is self:
+                    self._pbucket.placement_owner = None
+                self._pbucket = None
         await self.informer.stop()
         await self.cluster_informer.stop()

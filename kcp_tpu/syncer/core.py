@@ -53,6 +53,7 @@ from ..models.reconcile_model import (
     ReconcileState,
     reconcile_step_packed,
     unpack_patches,
+    unpack_placement,
 )
 from ..ops.encode import pad_pow2
 from ..reconciler.controller import BatchController
@@ -168,6 +169,19 @@ class FusedBucket:
         self.row_owner: dict[int, Section] = {}
         self._free: list[int] = []
         self._next = 0
+        # placement lanes (the deployment splitter's serving section):
+        # root rows with replicas + per-cluster availability, returned as
+        # compacted dirty rows in the wire's placement segment
+        self.placement_owner = None
+        self.P = 8
+        self.R = 0
+        self.pl_replicas = np.zeros(0, np.int32)
+        self.pl_avail = np.zeros((0, 8), bool)
+        self.pl_rows: dict[object, int] = {}
+        self.pl_row_keys: dict[int, object] = {}
+        self._pl_free: list[int] = []
+        self._pl_next = 0
+        self._pl_staged = False
         self._state: ReconcileState | None = None
         self._stale = True
         self.patch_capacity = MIN_PATCH_CAPACITY
@@ -227,6 +241,81 @@ class FusedBucket:
     def mark_stale(self) -> None:
         self._stale = True
 
+    # -------------------------------------------------------- placement
+
+    def register_placement(self, owner, p: int = 8) -> None:
+        """Attach the deployment splitter as this bucket's placement
+        owner: its roots ride the replicas/avail lanes of the SAME fused
+        step that serves the sync sections (VERDICT r3 item 5 — the
+        serving tick computes real placement, not zeros)."""
+        if self.placement_owner is not None and self.placement_owner is not owner:
+            raise RuntimeError("bucket already has a placement owner")
+        self.placement_owner = owner
+        self.P = pad_pow2(max(p, 1), floor=8)
+        if self.pl_avail.shape[1] != self.P:
+            old = self.pl_avail
+            self.pl_avail = np.zeros((old.shape[0], self.P), bool)
+            self.pl_avail[:, : old.shape[1]] = old[:, : self.P]
+            self.mark_stale()
+
+    def pl_row_for(self, key) -> int:
+        row = self.pl_rows.get(key)
+        if row is None:
+            if self._pl_free:
+                row = self._pl_free.pop()
+            else:
+                if self._pl_next >= self.R:
+                    self._pl_grow(self._pl_next + 1)
+                row = self._pl_next
+                self._pl_next += 1
+            self.pl_rows[key] = row
+            self.pl_row_keys[row] = key
+        return row
+
+    def free_pl_row(self, key) -> None:
+        row = self.pl_rows.pop(key, None)
+        if row is None:
+            return
+        self.pl_row_keys.pop(row, None)
+        self.pl_replicas[row] = 0
+        self.pl_avail[row] = False
+        self._pl_free.append(row)
+        self._pl_staged = True
+
+    def _pl_grow(self, needed: int) -> None:
+        new_r = pad_pow2(max(needed, 8))
+        if new_r % self._row_factor:
+            new_r += self._row_factor - new_r % self._row_factor
+        reps = np.zeros(new_r, np.int32)
+        reps[: self.R] = self.pl_replicas
+        avail = np.zeros((new_r, self.P), bool)
+        avail[: self.R] = self.pl_avail
+        self.pl_replicas, self.pl_avail = reps, avail
+        self.R = new_r
+        # shape change: the resident current[R,P] must be rebuilt too
+        self.mark_stale()
+
+    def stage_placement(self, key, replicas: int, n_clusters: int) -> None:
+        """Stage one root's desired placement inputs (replicas + how many
+        of the P cluster slots are available). The width grows on demand
+        — P is a padding floor, never a silent cap (matching the host
+        splitter's 'width follows the widest row' contract)."""
+        row = self.pl_row_for(key)
+        if n_clusters > self.P:
+            self._pl_widen(pad_pow2(n_clusters, floor=8))
+        self.pl_replicas[row] = replicas
+        self.pl_avail[row] = False
+        self.pl_avail[row, :n_clusters] = True
+        self._pl_staged = True
+
+    def _pl_widen(self, new_p: int) -> None:
+        avail = np.zeros((self.R, new_p), bool)
+        avail[:, : self.P] = self.pl_avail
+        self.pl_avail = avail
+        self.P = new_p
+        # shape change: resident avail/current must be rebuilt
+        self.mark_stale()
+
     # ------------------------------------------------------------ events
 
     def stage(self, row: int, side: bool, vals: np.ndarray, exists: bool) -> None:
@@ -244,23 +333,31 @@ class FusedBucket:
 
     @property
     def dirty(self) -> bool:
-        return bool(self._staged) or self._stale
+        return bool(self._staged) or self._stale or self._pl_staged
 
     # -------------------------------------------------------------- tick
 
     def _device_state(self) -> ReconcileState:
-        # minimal splitter/fanout lanes: the sync serving path doesn't use
-        # them, but the program IS the flagship step, lanes and all
-        # (placement rows are row-sharded too — pad to the row factor)
+        # placement lanes: real when a placement owner registered (the
+        # splitter's roots), minimal placeholders otherwise — either way
+        # the program IS the flagship step, lanes and all (placement
+        # rows are row-sharded too — pad to the row factor)
         f = self._row_factor
-        r = ((8 + f - 1) // f) * f
-        p, l, c = 8, 1, 8
+        if self.R:
+            replicas, avail = self.pl_replicas, self.pl_avail
+            r, p = self.R, self.P
+        else:
+            r = ((8 + f - 1) // f) * f
+            p = 8
+            replicas = np.zeros(r, np.int32)
+            avail = np.zeros((r, p), bool)
+        l, c = 1, 8
         state = ReconcileState(
             up_vals=self.up_vals, up_exists=self.up_exists,
             down_vals=self.down_vals, down_exists=self.down_exists,
             status_mask=self.status_mask,
-            replicas=np.zeros(r, np.int32),
-            avail=np.zeros((r, p), bool),
+            replicas=replicas,
+            avail=avail,
             current=np.zeros((r, p), np.int32),
             pair_hashes=np.zeros((self.B, l), np.uint32),
             sel_hashes=np.zeros(c, np.uint32),
@@ -271,9 +368,10 @@ class FusedBucket:
             return shard_state(state, self.mesh)
         return jax.tree.map(jax.device_put, state)
 
-    def submit(self) -> jax.Array | None:
+    def submit(self) -> tuple[jax.Array, tuple[int, int]] | None:
         """Upload staged events, run one fused step, return the wire array
-        (with copy_to_host_async issued). None if nothing to do."""
+        (with copy_to_host_async issued) plus the (patch_capacity, P)
+        needed to unpack it. None if nothing to do."""
         if not self.dirty:
             return None
         s = self.S
@@ -281,11 +379,28 @@ class FusedBucket:
             self._state = self._device_state()
             self._stale = False
             self._staged.clear()
+            self._pl_staged = False
             self.stats["full_uploads"] += 1
             # full upload replaces the mirrors wholesale; still run the
             # step so decisions for the new state come back
             packed = np.zeros((MIN_EVENTS, s + 2), np.uint32)
         else:
+            if self._pl_staged:
+                # placement inputs changed (roots staged/retired): swap
+                # ONLY the small replicas/avail leaves — never the [B,S]
+                # mirrors (shapes are stable here; growth marks stale)
+                self._pl_staged = False
+                reps, avail = self.pl_replicas.copy(), self.pl_avail.copy()
+                if self.mesh is not None:
+                    from ..parallel.mesh import state_shardings
+
+                    sh = state_shardings(self.mesh)
+                    reps = jax.device_put(reps, sh["placement_rows"])
+                    avail = jax.device_put(avail, sh["placement"])
+                else:
+                    reps = jax.device_put(reps)
+                    avail = jax.device_put(avail)
+                self._state = self._state._replace(replicas=reps, avail=avail)
             # build the packed wire array directly (one pass; the
             # ReconcileDeltas + pack_deltas detour cost ~20% of loop
             # wall time at bench scale — see round-4 profile)
@@ -305,16 +420,18 @@ class FusedBucket:
             packed = jax.device_put(packed, NamedSharding(self.mesh, PartitionSpec()))
         else:
             packed = jax.device_put(packed)
+        k = min(self.patch_capacity, self.B)
         self._state, wire = self._step(
-            self._state, packed, patch_capacity=min(self.patch_capacity, self.B),
+            self._state, packed, patch_capacity=k,
             use_pallas=self.use_pallas,
         )
         wire.copy_to_host_async()
         self.stats["ticks"] += 1
-        return wire
+        return wire, (k, int(self._state.avail.shape[1]))
 
-    def dispatch(self, wire: np.ndarray) -> bool:
-        """Route a collected wire's patches to owning sections.
+    def dispatch(self, wire: np.ndarray, meta: tuple[int, int]) -> bool:
+        """Route a collected wire's patches (and dirty placement rows) to
+        their owners.
 
         Returns True if the patch set overflowed (caller re-ticks after
         doubling capacity)."""
@@ -329,6 +446,16 @@ class FusedBucket:
                 per_section.setdefault(s, []).append((key, c, u))
         for s, patches in per_section.items():
             s.owner.fused_apply(patches)
+        if self.placement_owner is not None:
+            k, p = meta
+            rows, counts = unpack_placement(wire, k, p)
+            applies = []
+            for i, row in enumerate(rows.tolist()):
+                key = self.pl_row_keys.get(row)
+                if key is not None:
+                    applies.append((key, counts[i]))
+            if applies:
+                self.placement_owner.placement_apply(applies)
         if overflow:
             self.stats["overflows"] += 1
             self.patch_capacity = min(self.patch_capacity * 2, max(self.B, MIN_ROWS))
@@ -429,6 +556,20 @@ class FusedCore:
     def register(self, owner: SectionOwner, slots: int) -> Section:
         return self.bucket(slots).section(owner)
 
+    def register_placement(self, owner, p: int = 8,
+                           slots: int = 64) -> FusedBucket:
+        """Attach a placement owner (the deployment splitter) to the
+        default bucket — its roots then ride the SAME fused step that
+        serves the sync sections."""
+        b = self.bucket(slots)
+        b.register_placement(owner, p)
+        return b
+
+    def kick(self, bucket: FusedBucket) -> None:
+        """Request a tick for a bucket dirtied outside the section path
+        (placement staging)."""
+        self.controller.queue.add(("__kick__", False, id(bucket), None))
+
     def enqueue(self, section: Section, side: bool, key) -> None:
         self.controller.enqueue((id(section.owner), side, key, section))
 
@@ -458,7 +599,7 @@ class FusedCore:
         # 2. one fused step per dirty bucket; collection is pipelined
         for bucket in self.buckets.values():
             try:
-                wire = bucket.submit()
+                submitted = bucket.submit()
             except Exception:
                 # surface loudly: a submit failure (bad sharding, device
                 # error) otherwise dies as 5 silent INFO-level retries
@@ -466,23 +607,24 @@ class FusedCore:
                               "(B=%d S=%d mesh=%s)", bucket.B, bucket.S,
                               bucket.mesh is not None)
                 raise
-            if wire is not None:
-                self._inflight.append((bucket, wire))
+            if submitted is not None:
+                wire, meta = submitted
+                self._inflight.append((bucket, wire, meta))
 
         # 3. collect: per BUCKET, oldest in-flight wires beyond FETCH_DEPTH
         #    (blocking is fine by then — their data has had a full tick to
         #    land). Depth is per bucket so one bucket's fresh wire never
         #    forces a zero-depth blocking collect of another's.
         counts: dict[int, int] = {}
-        for b, _w in self._inflight:
+        for b, _w, _m in self._inflight:
             counts[id(b)] = counts.get(id(b), 0) + 1
         i = 0
         while i < len(self._inflight):
-            b, w = self._inflight[i]
+            b, w, m = self._inflight[i]
             if counts[id(b)] > FETCH_DEPTH:
                 self._inflight.pop(i)
                 counts[id(b)] -= 1
-                self._collect(b, w)
+                self._collect(b, w, m)
             else:
                 i += 1
         self._schedule_flush()
@@ -504,8 +646,9 @@ class FusedCore:
             section.bucket.stage(row, True, down_v, down_e)
         section.refresh_mask()
 
-    def _collect(self, bucket: FusedBucket, wire: jax.Array) -> None:
-        overflow = bucket.dispatch(np.asarray(wire))
+    def _collect(self, bucket: FusedBucket, wire: jax.Array,
+                 meta: tuple[int, int]) -> None:
+        overflow = bucket.dispatch(np.asarray(wire), meta)
         if overflow:
             # level-triggered: re-run the bucket with doubled capacity
             bucket.mark_stale()
@@ -523,11 +666,11 @@ class FusedCore:
         try:
             await asyncio.sleep(IDLE_FLUSH_S)
             while self._inflight:
-                bucket, wire = self._inflight[0]
+                bucket, wire, meta = self._inflight[0]
                 while not wire.is_ready():
                     await asyncio.sleep(0.001)
                 self._inflight.pop(0)
-                self._collect(bucket, wire)
+                self._collect(bucket, wire, meta)
         except asyncio.CancelledError:
             pass
 

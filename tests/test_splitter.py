@@ -154,3 +154,72 @@ def test_rebalance_mode_adapts_to_cluster_changes():
         )
         await splitter.stop()
     asyncio.run(main())
+
+
+def test_placement_rides_the_fused_serving_core():
+    """VERDICT r3 item 5: with backend=tpu the split is computed by the
+    FusedCore's flagship step (placement lanes + wire segment), not a
+    separate split_replicas_jit call — and a sync engine sharing the
+    loop shares the same bucket/program."""
+    from kcp_tpu.syncer.core import FusedCore
+
+    async def main():
+        store = LogicalStore()
+        mc = MultiClusterClient(store)
+        tenant = mc.cluster_client("tenant-1")
+        for name in ("a", "b", "c"):
+            tenant.create("clusters.cluster.example.dev", new_cluster(name))
+
+        splitter = DeploymentSplitter(mc)
+        await splitter.start()
+        core = FusedCore.for_current_loop()
+        assert splitter.core is core
+        bucket = splitter._pbucket
+        assert bucket is core.bucket(64)
+        assert bucket.placement_owner is splitter
+
+        tenant.create(DEPLOYMENTS, deployment("web", 11))
+        await eventually(lambda: tenant.get(DEPLOYMENTS, "web--c", "default"))
+        # remainder->first parity through the device lane: 11 over 3
+        assert tenant.get(DEPLOYMENTS, "web--a", "default")["spec"]["replicas"] == 5
+        assert tenant.get(DEPLOYMENTS, "web--b", "default")["spec"]["replicas"] == 3
+        assert tenant.get(DEPLOYMENTS, "web--c", "default")["spec"]["replicas"] == 3
+        assert splitter.stats["fused_placements"] >= 1
+        assert bucket.stats["ticks"] >= 1
+        assert bucket.R >= 8  # placement rows materialized in the state
+        await splitter.stop()
+
+    asyncio.run(main())
+
+
+def test_fused_placement_apply_failure_retries_from_cache():
+    """A failed fused apply must not be lost: counts are cached and the
+    root requeues rate-limited (re-staging identical inputs would not
+    re-dirty the device row)."""
+
+    async def main():
+        store = LogicalStore()
+        mc = MultiClusterClient(store)
+        tenant = mc.cluster_client("tenant-1")
+        tenant.create("clusters.cluster.example.dev", new_cluster("east"))
+
+        splitter = DeploymentSplitter(mc)
+        real_apply = splitter._apply_placement
+        fails = {"n": 2}
+
+        def flaky(*args, **kwargs):
+            if fails["n"] > 0:
+                fails["n"] -= 1
+                raise RuntimeError("injected apply failure")
+            return real_apply(*args, **kwargs)
+
+        splitter._apply_placement = flaky
+        await splitter.start()
+        tenant.create(DEPLOYMENTS, deployment("web", 4))
+        await eventually(
+            lambda: tenant.get(DEPLOYMENTS, "web--east", "default"), timeout=10)
+        assert tenant.get(DEPLOYMENTS, "web--east", "default")["spec"]["replicas"] == 4
+        assert fails["n"] == 0
+        await splitter.stop()
+
+    asyncio.run(main())
