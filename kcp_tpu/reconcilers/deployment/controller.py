@@ -297,7 +297,12 @@ class DeploymentSplitter:
         if len(clusters) != self._staged_n.get(key) or self._counts_stale(root, counts):
             # the cluster set / spec / row assignment changed while the
             # tick was in flight: restage with current inputs instead of
-            # applying stale counts
+            # applying stale counts. The device's `current` has already
+            # advanced past the rejected split, so force the placement
+            # rows to re-emit — identical re-staged inputs would never
+            # re-dirty otherwise
+            if self._pbucket is not None:
+                self._pbucket.invalidate_placement()
             self.controller.enqueue(("root", key))
             return
         leafs = self.informer.index("owned_by", "/".join(key))

@@ -116,6 +116,13 @@ class Server:
 
     async def start(self) -> None:
         """Bring the server up and fire hooks; returns once serving."""
+        from ..utils.raceguard import LoopWatchdog
+
+        # stall visibility on the serving loop (the race/sanitizer story's
+        # production half): a reconcile blocking the loop past 1s is
+        # logged with the offending stacks
+        self._watchdog = LoopWatchdog(asyncio.get_running_loop(),
+                                      threshold=1.0).start()
         await self.http.start()
         if self.config.durable:
             render_kubeconfig(self.address,
@@ -187,6 +194,9 @@ class Server:
         self._stop.set()
 
     async def shutdown(self) -> None:
+        if getattr(self, "_watchdog", None) is not None:
+            self._watchdog.stop()
+            self._watchdog = None
         for c in reversed(self._controllers):
             await c.stop()
         self._controllers = []

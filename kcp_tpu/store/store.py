@@ -270,6 +270,12 @@ class LogicalStore:
         # SchemaConverter, pkg/crdpuller/discovery.go:190-207). Not
         # persisted: it is serving metadata, not state.
         self.openapi_doc: dict | None = None
+        # race detection (KCP_RACE=1, the `go test -race` analog): the
+        # store is loop-owned single-threaded state — every mutation
+        # asserts it runs on the owning thread (utils/raceguard.py)
+        from ..utils.raceguard import AffinityGuard
+
+        self._race_guard = AffinityGuard("LogicalStore")
         self._objects: dict[Key, dict] = {}
         self._rv = 0
         self._watches: list[Watch] = []
@@ -350,6 +356,7 @@ class LogicalStore:
     # --------------------------------------------------------------- CRUD
 
     def create(self, resource: str, cluster: str, obj: dict, namespace: str = "") -> dict:
+        self._race_guard.check()
         obj = copy.deepcopy(obj)
         meta = obj.setdefault("metadata", {})
         name = meta.get("name")
@@ -398,6 +405,7 @@ class LogicalStore:
         namespace: str = "",
         subresource: str | None = None,
     ) -> dict:
+        self._race_guard.check()
         obj = copy.deepcopy(obj)
         meta = self._meta(obj)
         name = meta.get("name")
@@ -462,6 +470,7 @@ class LogicalStore:
         return self.update(resource, cluster, obj, namespace, subresource="status")
 
     def delete(self, resource: str, cluster: str, name: str, namespace: str = "") -> None:
+        self._race_guard.check()
         key = self._key(resource, cluster, namespace, name)
         existing = self._objects.get(key)
         if existing is None:

@@ -280,7 +280,18 @@ class FusedBucket:
         self.pl_replicas[row] = 0
         self.pl_avail[row] = False
         self._pl_free.append(row)
-        self._pl_staged = True
+        # the device-resident `current` still holds this row's last split;
+        # a future occupant staging inputs whose split EQUALS it would
+        # never re-dirty — rebuild the resident state (root retirement is
+        # rare relative to ticks, so the full upload is acceptable)
+        self.mark_stale()
+
+    def invalidate_placement(self) -> None:
+        """Force every placement row to re-emit on the next tick (rebuilds
+        the resident state, zeroing `current`). Used when a host-side
+        apply rejected device counts — identical re-staged inputs would
+        otherwise never re-dirty."""
+        self.mark_stale()
 
     def _pl_grow(self, needed: int) -> None:
         new_r = pad_pow2(max(needed, 8))
@@ -453,7 +464,9 @@ class FusedBucket:
             for i, row in enumerate(rows.tolist()):
                 key = self.pl_row_keys.get(row)
                 if key is not None:
-                    applies.append((key, counts[i]))
+                    # copy: a view would pin the whole wire buffer in the
+                    # applier queue / retry cache
+                    applies.append((key, counts[i].copy()))
             if applies:
                 self.placement_owner.placement_apply(applies)
         if overflow:

@@ -13,6 +13,9 @@ subtleties of this environment:
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# run the whole suite race-checked — the `go test -race ./...` analog
+# (utils/raceguard.py): store mutations assert thread affinity
+os.environ.setdefault("KCP_RACE", "1")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,99 @@
+"""Race detection: the `go test -race` analog (SURVEY §5, ci.yaml:64).
+
+The whole suite runs with KCP_RACE=1 (conftest), so every store mutation
+in every test is affinity-checked; these tests pin the detector itself.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+
+import pytest
+
+from kcp_tpu.store import LogicalStore
+from kcp_tpu.utils.raceguard import AffinityGuard, LoopWatchdog, RaceError, enabled
+
+
+def cm(name):
+    return {"apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": name, "namespace": "default"}, "data": {}}
+
+
+def test_suite_runs_race_checked():
+    assert enabled(), "conftest must enable KCP_RACE for the whole suite"
+
+
+def test_cross_thread_store_mutation_is_a_race():
+    store = LogicalStore()
+    store.create("configmaps", "t", cm("a"))  # claims this thread
+
+    caught: list[BaseException] = []
+
+    def other():
+        try:
+            store.create("configmaps", "t", cm("b"))
+        except BaseException as e:  # noqa: BLE001
+            caught.append(e)
+
+    t = threading.Thread(target=other)
+    t.start()
+    t.join()
+    assert caught and isinstance(caught[0], RaceError)
+    assert "owned by thread" in str(caught[0])
+
+
+def test_rebind_hands_ownership_across_the_embedding_seam():
+    store = LogicalStore()
+    store.create("configmaps", "t", cm("a"))
+
+    done = threading.Event()
+    errs: list[BaseException] = []
+
+    def server_thread():
+        try:
+            store._race_guard.rebind()  # the ServerThread seam
+            store.create("configmaps", "t", cm("b"))
+        except BaseException as e:  # noqa: BLE001
+            errs.append(e)
+        finally:
+            done.set()
+
+    threading.Thread(target=server_thread).start()
+    done.wait()
+    assert not errs
+    # and now THIS thread is the intruder
+    with pytest.raises(RaceError):
+        store.create("configmaps", "t", cm("c"))
+
+
+def test_guard_is_free_when_disabled(monkeypatch):
+    monkeypatch.delenv("KCP_RACE", raising=False)
+    g = AffinityGuard("x")
+    g.check()
+
+    out = []
+
+    def other():
+        g.check()  # no error with detection off
+        out.append(True)
+
+    t = threading.Thread(target=other)
+    t.start()
+    t.join()
+    assert out == [True]
+
+
+def test_loop_watchdog_catches_a_blocked_loop(caplog):
+    async def main():
+        wd = LoopWatchdog(asyncio.get_running_loop(),
+                          threshold=0.1, interval=0.01).start()
+        await asyncio.sleep(0.05)  # let the watchdog arm
+        time.sleep(0.5)  # a synchronous block on the reconcile loop
+        await asyncio.sleep(0.1)
+        wd.stop()
+        return wd.stalls
+
+    stalls = asyncio.run(main())
+    assert stalls and max(stalls) > 0.1
