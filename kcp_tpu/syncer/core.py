@@ -412,19 +412,30 @@ class FusedBucket:
                     reps = jax.device_put(reps)
                     avail = jax.device_put(avail)
                 self._state = self._state._replace(replicas=reps, avail=avail)
-            # build the packed wire array directly (one pass; the
-            # ReconcileDeltas + pack_deltas detour cost ~20% of loop
-            # wall time at bench scale — see round-4 profile)
+            # build the packed wire array directly — vectorized: one
+            # np.stack instead of a per-event python copy loop (the loop
+            # was ~30% of serving wall time at bench scale; flags are
+            # exists | side<<1 | valid<<2, the unpack_deltas layout)
             staged = self._staged
             self._staged = {}
-            d = pad_pow2(len(staged), floor=MIN_EVENTS)
+            n = len(staged)
+            d = pad_pow2(n, floor=MIN_EVENTS)
             packed = np.zeros((d, s + 2), np.uint32)
-            for i, ((row, sd), (v, ex)) in enumerate(staged.items()):
-                packed[i, : v.shape[0]] = v
-                packed[i, s] = row
-                # flags: exists | side<<1 | valid<<2 (reconcile_model
-                # unpack_deltas layout)
-                packed[i, s + 1] = (1 if ex else 0) | (2 if sd else 0) | 4
+            vals = [ve[0] for ve in staged.values()]
+            try:
+                stacked = np.stack(vals)
+            except ValueError:
+                # ragged widths (an engine mid-migration): slow path
+                for i, v in enumerate(vals):
+                    packed[i, : v.shape[0]] = v
+            else:
+                packed[:n, : stacked.shape[1]] = stacked
+            packed[:n, s] = np.fromiter(
+                (row for row, _sd in staged), np.uint32, n)
+            packed[:n, s + 1] = np.fromiter(
+                ((1 if ex else 0) | (2 if sd else 0) | 4
+                 for (_row, sd), (_v, ex) in staged.items()),
+                np.uint32, n)
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
 

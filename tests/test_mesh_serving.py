@@ -188,3 +188,18 @@ def test_sharded_overflow_and_growth_paths():
         await syncer.stop()
 
     asyncio.run(main())
+
+
+def test_sharded_serving_on_3d_multihost_mesh():
+    """The full sync scenario (creates + update/delete/status-upsync)
+    also runs on the hosts-major 3D layout a real multi-host pod would
+    use (DCN-major axis; parallel/mesh.py)."""
+    mesh = mesh_from_spec("2x2x2")
+    down_s, up_s, bucket = asyncio.run(drive_scenario(mesh))
+    down_1, up_1, _ = asyncio.run(drive_scenario(None))
+    assert down_s == down_1
+    assert up_s == up_1
+    assert bucket.mesh is mesh
+    # rows fold over (hosts, tenants): tenant blocks nest in host blocks
+    assert tuple(bucket._state.up_vals.sharding.spec) == (
+        ("hosts", TENANTS_AXIS), SLOTS_AXIS)
