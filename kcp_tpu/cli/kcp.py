@@ -60,6 +60,9 @@ def build_parser():
                             "minted into admin.kubeconfig")
     start.add_argument("--admin-token", default="",
                        help="fixed admin bearer token (minted when empty)")
+    start.add_argument("--pallas", action="store_true",
+                       help="serve the fused Pallas decide+match kernel "
+                            "instead of the XLA lanes (single-device)")
     start.add_argument("--no-tls", action="store_true",
                        help="serve plaintext HTTP instead of the default "
                             "self-signed TLS endpoint")
@@ -95,6 +98,7 @@ def config_from_args(args) -> Config:
         authz=args.authz,
         admin_token=args.admin_token,
         tls=not args.no_tls,
+        pallas=args.pallas,
         mesh=args.mesh,
     )
 

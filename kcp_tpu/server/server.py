@@ -49,6 +49,8 @@ class Config:
     # fused reconcile core's buckets over a jax device mesh (SURVEY §7.2
     # step 9; the reference's horizontal-sharding story,
     # docs/investigations/logical-clusters.md:83)
+    pallas: bool = False  # serve the fused Pallas decide+match kernel
+    # (ops/pallas_kernels.py) instead of the XLA lanes (single-device)
 
 
 class Server:
@@ -157,6 +159,11 @@ class Server:
 
         mode = {"push": SyncerMode.PUSH, "pull": SyncerMode.PULL,
                 "none": SyncerMode.NONE}[self.config.syncer_mode]
+        if self.config.pallas and os.environ.get("KCP_PALLAS") != "1":
+            # FusedCore.for_current_loop reads this at construction; the
+            # env form also reaches pull-mode pods via their environment
+            os.environ["KCP_PALLAS"] = "1"
+            self._set_pallas_env = True
         mesh = None
         if self.config.mesh:
             from ..parallel.mesh import set_serving_mesh
@@ -197,6 +204,9 @@ class Server:
         if getattr(self, "_watchdog", None) is not None:
             self._watchdog.stop()
             self._watchdog = None
+        if getattr(self, "_set_pallas_env", False):
+            os.environ.pop("KCP_PALLAS", None)
+            self._set_pallas_env = False
         for c in reversed(self._controllers):
             await c.stop()
         self._controllers = []
