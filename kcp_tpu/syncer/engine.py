@@ -170,9 +170,13 @@ class BatchSyncEngine:
         self._apply_tasks: list[asyncio.Task] = []
         self._retry_tasks: set[asyncio.Task] = set()
 
-        # convergence bookkeeping for the p99 metric: key -> first-dirty time
+        # convergence bookkeeping for the p99 metric: key -> first-dirty
+        # time; samples are bounded (a long-running server must not grow
+        # them forever — the histogram in utils/trace keeps the totals)
+        from collections import deque
+
         self.dirty_since: dict[tuple[str, str], float] = {}
-        self.convergence_samples: list[float] = []
+        self.convergence_samples: "deque[float]" = deque(maxlen=10_000)
         self.stats = {"ticks": 0, "decisions_applied": 0, "rows": 0, "full_uploads": 0}
 
     def tick_count(self) -> int:
