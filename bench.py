@@ -488,11 +488,18 @@ def suite() -> int:
         rows.append(("decision+fanout XLA", f"{b} rows x {s} slots",
                      f"{b / dt_x / 1e6:.0f}M rows/s"))
         report()
+        from kcp_tpu.ops.pallas_kernels import default_interpret
+
         dt_p = _time_kernel(decide_and_match, up, upe, down, dne, maskd,
                             pair, sels)
-        rows.append(("decision+fanout Pallas", f"{b} rows x {s} slots",
-                     f"{b / dt_p / 1e6:.0f}M rows/s "
-                     f"({dt_x / dt_p:.2f}x vs XLA)"))
+        interp = default_interpret()
+        rows.append((
+            "decision+fanout Pallas"
+            + (" [interpret mode]" if interp else ""),
+            f"{b} rows x {s} slots",
+            f"{b / dt_p / 1e6:.1f}M rows/s ({dt_x / dt_p:.2f}x vs XLA"
+            + ("; Mosaic-compiled only on TPU)" if interp else ")"),
+        ))
         report()
     except Exception as e:  # noqa: BLE001 — A/B lane is best-effort
         print(f"pallas A/B lane failed: {e}", file=sys.stderr)

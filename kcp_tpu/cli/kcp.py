@@ -69,8 +69,17 @@ def build_parser():
     start.add_argument("--mesh", default="",
                        help="serving-mesh spec to shard the fused reconcile "
                             "core over jax devices: N (tenants), NxM "
-                            "(tenants x slots) or NxMxK (hosts x tenants x "
-                            "slots), e.g. --mesh 4x2")
+                            "(tenants x slots), NxMxK (hosts x tenants x "
+                            "slots), or 'auto' (live topology; hosts-major "
+                            "on a multi-host pod), e.g. --mesh 4x2")
+    start.add_argument("--distributed", action="store_true",
+                       help="form the jax process group before serving "
+                            "(multi-host pods; see --coordinator)")
+    start.add_argument("--coordinator", default="",
+                       help="jax.distributed coordinator address "
+                            "(host:port); env JAX_COORDINATOR also works")
+    start.add_argument("--num-processes", type=int, default=None)
+    start.add_argument("--process-id", type=int, default=None)
     start.add_argument("-v", "--verbosity", type=int, default=0)
 
     snap = sub.add_parser(
@@ -149,6 +158,12 @@ def main(argv: list[str] | None = None) -> int:
         format="%(asctime)s %(levelname).1s %(name)s: %(message)s")
     if args.command == "snapshot":
         return snapshot_cmd(args)
+    if getattr(args, "distributed", False):
+        from ..parallel.distributed import init_distributed
+
+        init_distributed(coordinator=args.coordinator or None,
+                         num_processes=args.num_processes,
+                         process_id=args.process_id)
     asyncio.run(serve(config_from_args(args)))
     return 0
 

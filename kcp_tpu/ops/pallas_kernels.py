@@ -47,6 +47,13 @@ DECISION_DELETE = 3
 _LANES = 128  # rows per plane row; B must divide by it on TPU
 
 
+def default_interpret() -> bool:
+    """Whether decide_and_match will run under the Pallas interpreter by
+    default on the current backend (the single source of truth for the
+    bench's '[interpret mode]' annotation)."""
+    return jax.default_backend() == "cpu"
+
+
 def _decide_match_kernel(up_ref, down_ref, upe_ref, dne_ref, mask_ref,
                          pair_ref, sel_ref,
                          decision_ref, upsync_ref, counts_ref):
@@ -122,7 +129,7 @@ def decide_and_match(
     if b % br:
         raise ValueError(f"B={b} not divisible by block_rows={br}")
     if interpret is None:
-        interpret = jax.default_backend() == "cpu"
+        interpret = default_interpret()
     lanes = _LANES if br % _LANES == 0 else 1
     if not interpret and lanes == 1:
         raise ValueError(f"block_rows={br} must be a multiple of {_LANES} on TPU")

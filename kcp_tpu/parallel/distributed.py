@@ -1,0 +1,82 @@
+"""Multi-host bring-up: jax.distributed glue + topology-derived meshes.
+
+The reference scales horizontally by sharding kcp instances over etcd
+key ranges (future work in its docs, logical-clusters.md:83); this
+framework's multi-host story is a jax process group over DCN: every
+host runs the same server, `jax.distributed` forms the group, and the
+serving mesh folds rows over a hosts-major axis (parallel/mesh.py) so
+informer-delta ingestion stays host-local and only scalar stats cross
+DCN.
+
+``init_distributed`` wraps jax.distributed.initialize with explicit
+args or environment fallbacks (JAX's own auto-detection handles TPU
+pods where the metadata server provides topology). ``pod_serving_mesh``
+builds the canonical serving mesh from the LIVE process topology — the
+``--mesh auto`` spec.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+from .mesh import Mesh, make_mesh, make_multihost_mesh
+
+log = logging.getLogger(__name__)
+
+
+def init_distributed(
+    coordinator: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+    _dry_run: bool = False,
+) -> dict:
+    """Form the jax process group (idempotent; explicit single-process
+    configuration is a no-op).
+
+    Explicit args win; otherwise the JAX_COORDINATOR / JAX_NUM_PROCESSES
+    / JAX_PROCESS_ID env vars; otherwise jax.distributed's own
+    auto-detection runs (TPU pod metadata) — calling this function IS
+    the multi-host intent, so with nothing configured initialize() is
+    still invoked and left to auto-detect. Returns the kwargs used —
+    ``_dry_run`` skips the actual initialize (arg-assembly tests).
+    """
+    kwargs: dict = {}
+    coordinator = coordinator or os.environ.get("JAX_COORDINATOR")
+    if coordinator:
+        kwargs["coordinator_address"] = coordinator
+    n = num_processes if num_processes is not None else os.environ.get(
+        "JAX_NUM_PROCESSES")
+    if n is not None:
+        kwargs["num_processes"] = int(n)
+    pid = process_id if process_id is not None else os.environ.get(
+        "JAX_PROCESS_ID")
+    if pid is not None:
+        kwargs["process_id"] = int(pid)
+    if _dry_run:
+        return kwargs
+    if kwargs.get("num_processes") == 1:
+        log.info("explicit single-process serving; skipping jax.distributed")
+        return kwargs
+    import jax
+
+    if jax.distributed.is_initialized():
+        log.info("jax process group already formed; skipping initialize")
+        return kwargs
+    jax.distributed.initialize(**kwargs)
+    log.info("jax process group up: process %d/%d",
+             jax.process_index(), jax.process_count())
+    return kwargs
+
+
+def pod_serving_mesh(slots: int = 1) -> Mesh:
+    """The canonical serving mesh over the LIVE topology: hosts-major
+    when multi-process (DCN boundaries = process boundaries, so
+    jax.devices() ordering groups by process), flat tenants otherwise.
+    This is what ``--mesh auto`` resolves to."""
+    import jax
+
+    n_proc = jax.process_count()
+    if n_proc > 1:
+        return make_multihost_mesh(hosts=n_proc, slots=slots)
+    return make_mesh(slots=slots)

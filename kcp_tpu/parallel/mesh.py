@@ -63,9 +63,14 @@ def mesh_from_spec(spec: str, devices: list | None = None) -> Mesh:
     """Build a mesh from a CLI/config spec string.
 
     ``"8"`` -> (tenants=8,); ``"4x2"`` -> (tenants=4, slots=2);
-    ``"2x2x2"`` -> (hosts=2, tenants=2, slots=2). The flat device count
-    must be available.
+    ``"2x2x2"`` -> (hosts=2, tenants=2, slots=2); ``"auto"`` -> the
+    canonical mesh over the live process topology (hosts-major on a
+    multi-host pod). The flat device count must be available.
     """
+    if spec.strip().lower() == "auto":
+        from .distributed import pod_serving_mesh
+
+        return pod_serving_mesh()
     parts = spec.lower().replace("*", "x").split("x")
     if not parts or any(not p.strip().isdigit() for p in parts):
         raise ValueError(f"bad mesh spec {spec!r}: want N, NxM or NxMxK")

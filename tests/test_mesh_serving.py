@@ -203,3 +203,30 @@ def test_sharded_serving_on_3d_multihost_mesh():
     # rows fold over (hosts, tenants): tenant blocks nest in host blocks
     assert tuple(bucket._state.up_vals.sharding.spec) == (
         ("hosts", TENANTS_AXIS), SLOTS_AXIS)
+
+
+def test_mesh_auto_and_distributed_arg_assembly(monkeypatch):
+    """'--mesh auto' resolves the live topology (single-process: flat
+    tenants over all devices); init_distributed assembles explicit args
+    over env fallbacks (the multi-host bring-up seam)."""
+    from kcp_tpu.parallel.distributed import init_distributed
+
+    m = mesh_from_spec("auto")
+    assert dict(zip(m.axis_names, m.devices.shape)) == {
+        TENANTS_AXIS: len(jax.devices()), SLOTS_AXIS: 1}
+
+    monkeypatch.setenv("JAX_COORDINATOR", "envhost:1234")
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "4")
+    monkeypatch.setenv("JAX_PROCESS_ID", "2")
+    kw = init_distributed(_dry_run=True)
+    assert kw == {"coordinator_address": "envhost:1234",
+                  "num_processes": 4, "process_id": 2}
+    kw = init_distributed(coordinator="cli:9", num_processes=8,
+                          process_id=0, _dry_run=True)
+    assert kw == {"coordinator_address": "cli:9",
+                  "num_processes": 8, "process_id": 0}
+    # explicit single-process: a no-op (never raises, never initializes)
+    monkeypatch.delenv("JAX_COORDINATOR")
+    monkeypatch.delenv("JAX_NUM_PROCESSES")
+    monkeypatch.delenv("JAX_PROCESS_ID")
+    assert init_distributed(num_processes=1) == {"num_processes": 1}

@@ -198,6 +198,7 @@ def test_pull_mode_syncs_over_tls_end_to_end():
                 # status upsync back through the verified TLS channel
                 got["status"] = {"phase": "Bound"}
                 phys.update_status("configmaps", got)
+                deadline = asyncio.get_event_loop().time() + 20
                 while True:
                     o = admin.get("configmaps", "pulled", "default")
                     if o.get("status") == {"phase": "Bound"}:
