@@ -110,6 +110,36 @@ class RestHandler:
             except (TypeError, ValueError):
                 seconds = 2.0
             return Response.of_json(await sample_profile(seconds))
+        if head == "debug" and segs[1:] == ["trace"]:
+            # on-demand XLA/device trace (xprof): the device-side half of
+            # the profiling story. Same gate as /debug/profile.
+            if self.authorizer is not None:
+                from ..store.store import WILDCARD
+
+                user = self.authenticator.user_for(req.headers)
+                if not self.authorizer.allowed(user, WILDCARD, "get", "",
+                                               "debug"):
+                    return Response.of_json(
+                        _status_body(403, "Forbidden",
+                                     f'user "{user}" cannot trace'), 403)
+            import asyncio as _asyncio
+            import tempfile
+
+            from ..utils.trace import device_trace
+
+            try:
+                seconds = min(float(req.param("seconds", "2.0")), 30.0)
+            except (TypeError, ValueError):
+                seconds = 2.0
+            log_dir = req.param("dir") or tempfile.mkdtemp(
+                prefix="kcp-device-trace-")
+            with device_trace(log_dir) as started:
+                await _asyncio.sleep(seconds)
+            return Response.of_json({
+                "dir": log_dir, "seconds": seconds,
+                "started": bool(started),
+                "hint": "view with xprof/tensorboard --logdir",
+            })
         if head == "api":
             return await self._route_group(req, cluster, group="", segs=segs[1:])
         if head == "apis":

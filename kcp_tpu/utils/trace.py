@@ -182,7 +182,7 @@ def device_trace(log_dir: str):
     except Exception:
         pass
     try:
-        yield
+        yield started
     finally:
         if started:
             try:

@@ -99,3 +99,33 @@ def test_debug_profile_endpoint_and_gating():
         assert resp.status == 200
 
     asyncio.run(main())
+
+
+def test_debug_trace_endpoint():
+    """/debug/trace captures an on-demand XLA device trace (the xprof
+    half of the profiling surface); gated like /debug/profile."""
+    import os
+    import tempfile
+
+    async def main():
+        handler = RestHandler(LogicalStore(), default_scheme())
+        with tempfile.TemporaryDirectory() as d:
+            resp = await handler(_req("GET", "/debug/trace",
+                                      query={"seconds": ["0.2"],
+                                             "dir": [d]}))
+            assert resp.status == 200
+            out = json.loads(resp.body)
+            assert out["dir"] == d
+            if out["started"]:
+                # the jax profiler wrote a trace dir
+                assert os.listdir(d)
+
+        # gated when authz is on
+        authn = Authenticator(tokens={"admin-tok": "admin"})
+        store = LogicalStore()
+        handler = RestHandler(store, default_scheme(),
+                              authenticator=authn, authorizer=Authorizer(store))
+        resp = await handler(_req("GET", "/debug/trace"))
+        assert resp.status == 403
+
+    asyncio.run(main())
