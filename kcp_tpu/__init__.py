@@ -30,6 +30,17 @@ Layout:
 - ``kcp_tpu.reconcilers``  domain reconcilers (pkg/reconciler analog)
 - ``kcp_tpu.physical``     fake physical-cluster backend (kind analog)
 - ``kcp_tpu.cli``          CLI binaries (cmd/ analog)
+- ``kcp_tpu.utils``        errors, tracing/profiling, race detection
+- ``kcp_tpu.native``       ctypes bindings for the C++ runtime (native/)
+
+The serving core (``kcp_tpu.syncer.core``) fuses every engine's rows and
+the deployment splitter's placement into ONE reconcile-step program per
+schema bucket per tick — optionally sharded over a (hosts, tenants,
+slots) device mesh (``--mesh``), optionally through the Pallas
+decide+match kernel (``--pallas``). The server serves TLS by default
+with self-generated certs, RBAC-lite with escalation prevention, and
+/debug/profile (host sampling profiler) + /debug/trace (XLA) next to
+/metrics.
 """
 
 __version__ = "0.1.0"
