@@ -98,6 +98,7 @@ STATS_FIELDS = (
 
 def reconcile_step(state: ReconcileState, deltas: ReconcileDeltas,
                    patch_capacity: int = 8192, use_pallas: bool = False,
+                   mesh=None,
                    ) -> tuple[ReconcileState, ReconcileOutputs]:
     # 1. scatter deltas, routed by side (ops/diff.apply_deltas owns the
     #    padding-drop and dedup-by-key contract: delta batches must carry
@@ -112,19 +113,41 @@ def reconcile_step(state: ReconcileState, deltas: ReconcileDeltas,
     )
 
     b = up_vals.shape[0]
-    if use_pallas and b % 128 == 0:
+    local_b = b
+    if use_pallas and mesh is not None:
+        from ..parallel.mesh import row_factor, slot_factor
+
+        # the kernel runs per row-shard and needs full S per row: fall
+        # back to the (slot-partitioned) XLA lanes when b does not split
+        # exactly into 128-multiples per shard, or when the slots axis
+        # would force redundant all-gathered work on every slot shard
+        if b % (128 * row_factor(mesh)) == 0 and slot_factor(mesh) == 1:
+            local_b = b // row_factor(mesh)
+        else:
+            local_b = 1  # fails the gate below -> XLA lanes
+    if use_pallas and local_b % 128 == 0:
         # 2+4 fused: one Pallas pass reads each row block into VMEM once
         # and emits the decision lanes + per-selector match counts
         # (ops/pallas_kernels.py; differential-tested vs the XLA lanes).
-        # block_rows must DIVIDE b: pick the largest pow2 multiple of the
+        # On a mesh the kernel runs per device on its local row block via
+        # shard_map (counts psum across the row axes). block_rows must
+        # DIVIDE the local rows: pick the largest pow2 multiple of the
         # 128-lane width that does (128 always works given the gate)
-        from ..ops.pallas_kernels import decide_and_match
+        from ..ops.pallas_kernels import decide_and_match, decide_and_match_sharded
 
-        br = next(k for k in (4096, 2048, 1024, 512, 256, 128) if b % k == 0)
-        decision, status_upsync, match_counts = decide_and_match(
-            up_vals, up_exists, down_vals, down_exists, state.status_mask,
-            state.pair_hashes, state.sel_hashes, block_rows=br,
-        )
+        br = next(k for k in (4096, 2048, 1024, 512, 256, 128)
+                  if local_b % k == 0)
+        if mesh is not None:
+            decision, status_upsync, match_counts = decide_and_match_sharded(
+                mesh, up_vals, up_exists, down_vals, down_exists,
+                state.status_mask, state.pair_hashes, state.sel_hashes,
+                block_rows=br,
+            )
+        else:
+            decision, status_upsync, match_counts = decide_and_match(
+                up_vals, up_exists, down_vals, down_exists, state.status_mask,
+                state.pair_hashes, state.sel_hashes, block_rows=br,
+            )
         matched_total = match_counts.sum(dtype=jnp.int32)
     else:
         # 2. syncer lanes
@@ -177,7 +200,7 @@ def reconcile_step(state: ReconcileState, deltas: ReconcileDeltas,
 
 reconcile_step_jit = jax.jit(
     reconcile_step, donate_argnums=(0,),
-    static_argnames=("patch_capacity", "use_pallas"),
+    static_argnames=("patch_capacity", "use_pallas", "mesh"),
 )
 
 
@@ -244,6 +267,7 @@ def unpack_deltas(packed: jax.Array) -> ReconcileDeltas:
 
 def reconcile_step_packed(state: ReconcileState, packed: jax.Array,
                           patch_capacity: int = 8192, use_pallas: bool = False,
+                          mesh=None,
                           ) -> tuple[ReconcileState, jax.Array]:
     """The wire-format step: one uint32 array in, one int32 array out.
 
@@ -257,7 +281,7 @@ def reconcile_step_packed(state: ReconcileState, packed: jax.Array,
             f"shard the bucket or use the unpacked ReconcileOutputs lanes"
         )
     new_state, out = reconcile_step(state, unpack_deltas(packed), patch_capacity,
-                                    use_pallas=use_pallas)
+                                    use_pallas=use_pallas, mesh=mesh)
     entries = (
         out.patch_idx
         | (out.patch_code.astype(jnp.int32) << PACK_CODE_SHIFT)

@@ -188,3 +188,56 @@ def decide_and_match(
         upsync.reshape(b) != 0,
         counts[0],
     )
+
+
+def decide_and_match_sharded(
+    mesh,
+    up_vals: jax.Array,      # uint32 [B, S], rows sharded over the mesh
+    up_exists: jax.Array,    # bool [B]
+    down_vals: jax.Array,    # uint32 [B, S]
+    down_exists: jax.Array,  # bool [B]
+    status_mask: jax.Array,  # bool [S] replicated or [B, S] row-sharded
+    pair_hashes: jax.Array,  # uint32 [B, L]
+    sel_hashes: jax.Array,   # uint32 [C] replicated
+    block_rows: int = 4096,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """The fused pass on a sharded bucket: shard_map runs the Pallas
+    kernel per device on its local row block (slot columns are gathered
+    to full S per row — the kernel reduces over slots), and the
+    per-selector match counts psum across the row axes. Decision lanes
+    stay row-sharded; counts come back replicated.
+
+    This is the TPU-idiomatic composition: the kernel never knows about
+    the mesh, the mesh program never re-implements the kernel.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import HOSTS_AXIS, TENANTS_AXIS
+
+    row_axes = tuple(a for a in (HOSTS_AXIS, TENANTS_AXIS)
+                     if a in mesh.axis_names)
+    row = row_axes if len(row_axes) > 1 else row_axes[0]
+    per_row_mask = status_mask.ndim == 2
+    mask_spec = P(row, None) if per_row_mask else P()
+
+    def body(uv, ue, dv, de, m, ph, sh):
+        dec, ups, counts = decide_and_match(
+            uv, ue, dv, de, m, ph, sh,
+            block_rows=min(block_rows, uv.shape[0]), interpret=interpret)
+        for a in row_axes:
+            counts = jax.lax.psum(counts, axis_name=a)
+        return dec, ups, counts
+
+    smap = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(row, None), P(row), P(row, None), P(row), mask_spec,
+                  P(row, None), P()),
+        out_specs=(P(row), P(row), P()),
+        # pallas_call has no varying-manual-axes rule; skip the check
+        check_vma=False,
+    )
+    return smap(up_vals, up_exists, down_vals, down_exists, status_mask,
+                pair_hashes, sel_hashes)
+
+

@@ -145,6 +145,19 @@ def make_multihost_mesh(
     return Mesh(arr, (HOSTS_AXIS, TENANTS_AXIS, SLOTS_AXIS))
 
 
+def row_factor(mesh: Mesh) -> int:
+    """Product of the row-axis sizes (hosts x tenants) — the shard count
+    of every B/R dimension. THE single source for row-axis arithmetic
+    (bucket padding, the Pallas mesh gate)."""
+    dims = dict(zip(mesh.axis_names, mesh.devices.shape))
+    return dims.get(HOSTS_AXIS, 1) * dims.get(TENANTS_AXIS, 1)
+
+
+def slot_factor(mesh: Mesh) -> int:
+    """Size of the slots axis (1 when absent)."""
+    return dict(zip(mesh.axis_names, mesh.devices.shape)).get(SLOTS_AXIS, 1)
+
+
 def state_shardings(mesh: Mesh) -> dict[str, NamedSharding]:
     """NamedShardings for the reconcile state pytree (models/reconcile_model).
 

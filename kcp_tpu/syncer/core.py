@@ -142,18 +142,18 @@ class FusedBucket:
         self.B = 0
         self.mesh = mesh
         # the fused Pallas decision+fanout pass (ops/pallas_kernels.py);
-        # single-device only — the sharded path keeps the XLA lanes
-        self.use_pallas = use_pallas and mesh is None
+        # on a mesh it runs per device via shard_map (reconcile_model
+        # gates on local-row divisibility and falls back to XLA lanes)
+        self.use_pallas = use_pallas
         # sharded state must device_put cleanly: row counts are padded to
         # a multiple of the row-axis product (see _grow), and the slots
         # axis must divide the (power-of-two) slot capacity up front
         self._row_factor = 1
         if mesh is not None:
-            from ..parallel.mesh import HOSTS_AXIS, SLOTS_AXIS, TENANTS_AXIS
+            from ..parallel.mesh import row_factor, slot_factor
 
-            dims = dict(zip(mesh.axis_names, mesh.devices.shape))
-            self._row_factor = dims.get(HOSTS_AXIS, 1) * dims.get(TENANTS_AXIS, 1)
-            slot_dim = dims.get(SLOTS_AXIS, 1)
+            self._row_factor = row_factor(mesh)
+            slot_dim = slot_factor(mesh)
             if slots % slot_dim:
                 raise ValueError(
                     f"bucket slot capacity {slots} is not divisible by the "
@@ -189,7 +189,7 @@ class FusedBucket:
         self._staged: dict[tuple[int, bool], tuple[np.ndarray, bool]] = {}
         self._step = jax.jit(
             reconcile_step_packed, donate_argnums=(0,),
-            static_argnames=("patch_capacity", "use_pallas"),
+            static_argnames=("patch_capacity", "use_pallas", "mesh"),
         )
         self.stats = {"ticks": 0, "full_uploads": 0, "overflows": 0}
 
@@ -445,7 +445,7 @@ class FusedBucket:
         k = min(self.patch_capacity, self.B)
         self._state, wire = self._step(
             self._state, packed, patch_capacity=k,
-            use_pallas=self.use_pallas,
+            use_pallas=self.use_pallas, mesh=self.mesh,
         )
         wire.copy_to_host_async()
         self.stats["ticks"] += 1
@@ -499,10 +499,6 @@ class FusedCore:
 
             use_pallas = os.environ.get("KCP_PALLAS", "") == "1"
         self.use_pallas = use_pallas
-        if use_pallas and mesh is not None:
-            log.warning("KCP_PALLAS requested with a mesh; the fused "
-                        "Pallas pass is single-device only — using the "
-                        "XLA lanes for sharded buckets")
         self.buckets: dict[int, FusedBucket] = {}
         self.controller = BatchController(
             "fused-core", self._process_batch, batch_window=batch_window
