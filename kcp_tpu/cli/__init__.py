@@ -30,3 +30,24 @@ def apply_platform_env() -> None:
             jax.config.update("jax_platforms", want)
         except Exception:  # noqa: BLE001 — stay on the default platform
             pass
+    enable_compilation_cache()
+
+
+def enable_compilation_cache() -> None:
+    """Persistent XLA compilation cache for every binary: a recompile of
+    the fused step is a seconds-long serving stall (p99 poison), and the
+    cache also turns restart warmup from ~30 s of compiles into reads.
+    Opt out with KCP_NO_COMPILE_CACHE=1; relocate with KCP_COMPILE_CACHE.
+    """
+    if os.environ.get("KCP_NO_COMPILE_CACHE") == "1":
+        return
+    path = os.environ.get("KCP_COMPILE_CACHE") or os.path.join(
+        os.path.expanduser("~"), ".cache", "kcp_tpu", "xla")
+    try:
+        import jax
+
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:  # noqa: BLE001 — cache is an optimization only
+        pass

@@ -266,10 +266,22 @@ def unpack_deltas(packed: jax.Array) -> ReconcileDeltas:
 
 
 def reconcile_step_packed(state: ReconcileState, packed: jax.Array,
+                          acks: jax.Array | None = None,
                           patch_capacity: int = 8192, use_pallas: bool = False,
                           mesh=None,
                           ) -> tuple[ReconcileState, jax.Array]:
     """The wire-format step: one uint32 array in, one int32 array out.
+
+    ``acks`` is the converged-row compression lane: int32 row indices
+    (negative = padding) whose downstream mirror becomes a copy of the
+    resident upstream mirror. A feedback event whose encoded row equals
+    the up mirror the device already holds — the applier's up->down copy
+    observed back through the downstream informer — needs only these 4
+    bytes on the wire instead of a full (S+2)-column entry. The host
+    stager proves eligibility (values equal the host up mirror AND no
+    up-side entry staged this tick, so the resident row it copies is
+    exactly that value); the copy runs before the delta scatter, which
+    by the eligibility rule cannot touch an acked row's up side.
 
     Output layout: [0]=patch count, [1]=overflow flag, [2:10]=stats,
     [PACK_HDR:]=packed patch entries (see module comment).
@@ -280,6 +292,19 @@ def reconcile_step_packed(state: ReconcileState, packed: jax.Array,
             f"B={state.up_vals.shape[0]} exceeds {PACK_IDX_MASK} — "
             f"shard the bucket or use the unpacked ReconcileOutputs lanes"
         )
+    if acks is not None and state.up_vals.shape[0] > 0:
+        b = state.up_vals.shape[0]
+        valid = (acks >= 0) & (acks < b)
+        # padding (-1) must not scatter AT ALL: clipping it to a real row
+        # would race that row's genuine ack (duplicate-index scatter order
+        # is unspecified) — route padding out of bounds and drop it
+        idx = jnp.where(valid, acks, b)
+        gather = jnp.clip(acks, 0, b - 1)
+        down_vals = state.down_vals.at[idx].set(
+            state.up_vals[gather], mode="drop")
+        down_exists = state.down_exists.at[idx].set(
+            state.up_exists[gather], mode="drop")
+        state = state._replace(down_vals=down_vals, down_exists=down_exists)
     new_state, out = reconcile_step(state, unpack_deltas(packed), patch_capacity,
                                     use_pallas=use_pallas, mesh=mesh)
     entries = (

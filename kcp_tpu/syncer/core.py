@@ -43,6 +43,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import Callable, Protocol, Sequence
 
 import jax
@@ -57,8 +58,18 @@ from ..models.reconcile_model import (
 )
 from ..ops.encode import pad_pow2
 from ..reconciler.controller import BatchController
+from ..utils.trace import REGISTRY
 
 log = logging.getLogger(__name__)
+
+
+def _phase(name: str, dt: float) -> None:
+    """Record one tick-phase timing (histogram ``fused_<name>_seconds``).
+
+    The per-phase breakdown is the 'where does tick time go' answer the
+    /debug/profile surface and bench.py report; keep observations cheap —
+    one perf_counter pair per phase per tick, never per row."""
+    REGISTRY.histogram(f"fused_{name}_seconds").observe(dt)
 
 MIN_ROWS = 64
 MIN_EVENTS = 64
@@ -145,6 +156,11 @@ class FusedBucket:
         # on a mesh it runs per device via shard_map (reconcile_model
         # gates on local-row divisibility and falls back to XLA lanes)
         self.use_pallas = use_pallas
+        # converged-row ack compression kill switch, resolved once (the
+        # opt-out cannot change mid-process; staging is the hot path)
+        import os
+
+        self.use_acks = os.environ.get("KCP_NO_ACKS") != "1"
         # sharded state must device_put cleanly: row counts are padded to
         # a multiple of the row-axis product (see _grow), and the slots
         # axis must divide the (power-of-two) slot capacity up front
@@ -185,13 +201,33 @@ class FusedBucket:
         self._state: ReconcileState | None = None
         self._stale = True
         self.patch_capacity = MIN_PATCH_CAPACITY
-        # staged events for the next tick: (row, side) -> (vals, exists)
-        self._staged: dict[tuple[int, bool], tuple[np.ndarray, bool]] = {}
+        # staged events for the next tick, accumulated directly in the
+        # packed-wire layout (vals / row / flags) with last-wins dedup via
+        # an O(1) (row<<1|side) -> slot map. The dict-of-arrays this
+        # replaced cost ~23ms/tick at bench scale (encode staging + the
+        # np.stack repack); the array form stages and packs in ~2ms.
+        self._staged_slot = np.full(0, -1, np.int32)  # [2B] key -> slot
+        self._staged_vals = np.zeros((0, slots), np.uint32)
+        self._staged_rows = np.zeros(0, np.uint32)
+        self._staged_flags = np.zeros(0, np.uint32)
+        self._staged_keys = np.zeros(0, np.int64)  # slot -> key, for reset
+        # converged-row ack compression (reconcile_step_packed's acks
+        # lane): a down-side event equal to the resident up mirror ships
+        # as a 4-byte row index instead of an (S+2)-column entry
+        self._staged_ack = np.zeros(0, bool)
+        self._staged_n = 0
+        # acks-lane wire capacity: sticky high-water doubling, so the
+        # (packed, acks) shape pair stays stable after warmup — per-tick
+        # pow2 padding here would multiply compiled-shape variants. The
+        # floor is generous (4 KB of -1s) because a mid-serving growth
+        # costs a recompile — seconds of p99 — while padding costs ~µs
+        self.ack_capacity = 1024
         self._step = jax.jit(
             reconcile_step_packed, donate_argnums=(0,),
             static_argnames=("patch_capacity", "use_pallas", "mesh"),
         )
-        self.stats = {"ticks": 0, "full_uploads": 0, "overflows": 0}
+        self.stats = {"ticks": 0, "full_uploads": 0, "overflows": 0,
+                      "acked": 0}
 
     # ------------------------------------------------------------- rows
 
@@ -235,6 +271,9 @@ class FusedBucket:
         self.up_exists = grow(self.up_exists, (new_b,), bool)
         self.down_exists = grow(self.down_exists, (new_b,), bool)
         self.status_mask = grow(self.status_mask, (new_b, self.S), bool)
+        slot = np.full(2 * new_b, -1, np.int32)
+        slot[: self._staged_slot.shape[0]] = self._staged_slot
+        self._staged_slot = slot
         self.B = new_b
         self.mark_stale()
 
@@ -329,10 +368,32 @@ class FusedBucket:
 
     # ------------------------------------------------------------ events
 
+    def _ensure_staged_capacity(self, need: int) -> None:
+        cap = self._staged_vals.shape[0]
+        if need <= cap:
+            return
+        new_cap = pad_pow2(max(need, MIN_EVENTS))
+
+        def grow(a, shape, dtype):
+            out = np.zeros(shape, dtype)
+            out[: a.shape[0], ...] = a
+            return out
+
+        self._staged_vals = grow(self._staged_vals, (new_cap, self.S), np.uint32)
+        self._staged_rows = grow(self._staged_rows, (new_cap,), np.uint32)
+        self._staged_flags = grow(self._staged_flags, (new_cap,), np.uint32)
+        self._staged_keys = grow(self._staged_keys, (new_cap,), np.int64)
+        self._staged_ack = grow(self._staged_ack, (new_cap,), bool)
+
+    def _clear_staged(self) -> None:
+        n = self._staged_n
+        if n:
+            self._staged_slot[self._staged_keys[:n]] = -1
+            self._staged_n = 0
+
     def stage(self, row: int, side: bool, vals: np.ndarray, exists: bool) -> None:
         """Stage one delta event (last-wins per (row, side)) and mirror it
         into host staging (the rebuild source of truth)."""
-        self._staged[(row, side)] = (vals, exists)
         if side:
             self.down_vals[row, : vals.shape[0]] = vals
             self.down_vals[row, vals.shape[0]:] = 0
@@ -341,10 +402,67 @@ class FusedBucket:
             self.up_vals[row, : vals.shape[0]] = vals
             self.up_vals[row, vals.shape[0]:] = 0
             self.up_exists[row] = exists
+        key = (row << 1) | side
+        slot = self._staged_slot[key]
+        if slot < 0:
+            slot = self._staged_n
+            self._ensure_staged_capacity(slot + 1)
+            self._staged_slot[key] = slot
+            self._staged_keys[slot] = key
+            self._staged_rows[slot] = row
+            self._staged_n += 1
+        self._staged_vals[slot, : vals.shape[0]] = vals
+        self._staged_vals[slot, vals.shape[0]:] = 0
+        self._staged_flags[slot] = (1 if exists else 0) | (2 if side else 0) | 4
+        self._staged_ack[slot] = False
+
+    def stage_many(self, rows: np.ndarray, side: bool, vals: np.ndarray,
+                   exists: np.ndarray) -> None:
+        """Vectorized :meth:`stage` for one side of a unique row batch
+        (the fused_encode_many path): fancy-indexed mirror writes plus a
+        single slot-map pass, no per-event python loop."""
+        n, w = vals.shape
+        ack_ok = None
+        if side:
+            if self.use_acks:
+                # ack eligibility must be proven BEFORE any buffers
+                # change: the event's value equals the host up mirror
+                # (which equals the device's resident row, because no
+                # up-side entry is staged for it this tick) — then the
+                # device can produce the row itself from a 4-byte index
+                ack_ok = (exists & self.up_exists[rows]
+                          & (self._staged_slot[rows.astype(np.int64) << 1] < 0)
+                          & (vals == self.up_vals[rows, :w]).all(axis=1))
+                if w < self.S:
+                    ack_ok &= (self.up_vals[rows, w:] == 0).all(axis=1)
+            self.down_vals[rows, :w] = vals
+            self.down_vals[rows, w:] = 0
+            self.down_exists[rows] = exists
+        else:
+            self.up_vals[rows, :w] = vals
+            self.up_vals[rows, w:] = 0
+            self.up_exists[rows] = exists
+        keys = (rows.astype(np.int64) << 1) | (1 if side else 0)
+        slots = self._staged_slot[keys].astype(np.int64)
+        fresh = slots < 0
+        n_new = int(fresh.sum())
+        if n_new:
+            self._ensure_staged_capacity(self._staged_n + n_new)
+            new_slots = np.arange(self._staged_n, self._staged_n + n_new)
+            slots[fresh] = new_slots
+            self._staged_slot[keys[fresh]] = new_slots
+            self._staged_keys[new_slots] = keys[fresh]
+            self._staged_rows[slots] = rows
+            self._staged_n += n_new
+        self._staged_vals[slots, :w] = vals
+        self._staged_vals[slots, w:] = 0
+        self._staged_flags[slots] = (exists.astype(np.uint32)
+                                     | (2 if side else 0) | 4)
+        self._staged_ack[slots] = ack_ok if ack_ok is not None else False
 
     @property
     def dirty(self) -> bool:
-        return bool(self._staged) or self._stale or self._pl_staged
+        return bool(self._staged_n) or self._stale or self._pl_staged
 
     # -------------------------------------------------------------- tick
 
@@ -385,16 +503,19 @@ class FusedBucket:
         needed to unpack it. None if nothing to do."""
         if not self.dirty:
             return None
+        t0 = time.perf_counter()
         s = self.S
+        was_stale = self._stale
         if self._stale:
             self._state = self._device_state()
             self._stale = False
-            self._staged.clear()
+            self._clear_staged()
             self._pl_staged = False
             self.stats["full_uploads"] += 1
             # full upload replaces the mirrors wholesale; still run the
             # step so decisions for the new state come back
             packed = np.zeros((MIN_EVENTS, s + 2), np.uint32)
+            acks = None
         else:
             if self._pl_staged:
                 # placement inputs changed (roots staged/retired): swap
@@ -412,42 +533,57 @@ class FusedBucket:
                     reps = jax.device_put(reps)
                     avail = jax.device_put(avail)
                 self._state = self._state._replace(replicas=reps, avail=avail)
-            # build the packed wire array directly — vectorized: one
-            # np.stack instead of a per-event python copy loop (the loop
-            # was ~30% of serving wall time at bench scale; flags are
-            # exists | side<<1 | valid<<2, the unpack_deltas layout)
-            staged = self._staged
-            self._staged = {}
-            n = len(staged)
-            d = pad_pow2(n, floor=MIN_EVENTS)
+            # the staged buffers already hold the packed-wire layout
+            # (vals / row / flags, the unpack_deltas format) — one padded
+            # block copy and a reset of the slot map finish the pack.
+            # Ack-eligible slots ship on the 4-byte acks lane instead.
+            n = self._staged_n
+            ack_sel = self._staged_ack[:n]
+            na = int(ack_sel.sum())
+            nf = n - na
+            d = pad_pow2(nf, floor=MIN_EVENTS)
             packed = np.zeros((d, s + 2), np.uint32)
-            vals = [ve[0] for ve in staged.values()]
-            try:
-                stacked = np.stack(vals)
-            except ValueError:
-                # ragged widths (an engine mid-migration): slow path
-                for i, v in enumerate(vals):
-                    packed[i, : v.shape[0]] = v
+            if na:
+                self.stats["acked"] += na
+                while self.ack_capacity < na:
+                    self.ack_capacity *= 2
+                acks = np.full(self.ack_capacity, -1, np.int32)
+                full_sel = ~ack_sel
+                packed[:nf, :s] = self._staged_vals[:n][full_sel]
+                packed[:nf, s] = self._staged_rows[:n][full_sel]
+                packed[:nf, s + 1] = self._staged_flags[:n][full_sel]
+                acks[:na] = self._staged_rows[:n][ack_sel]
             else:
-                packed[:n, : stacked.shape[1]] = stacked
-            packed[:n, s] = np.fromiter(
-                (row for row, _sd in staged), np.uint32, n)
-            packed[:n, s + 1] = np.fromiter(
-                ((1 if ex else 0) | (2 if sd else 0) | 4
-                 for (_row, sd), (_v, ex) in staged.items()),
-                np.uint32, n)
+                acks = None  # its own trace-time variant: no scatter pass
+                packed[:n, :s] = self._staged_vals[:n]
+                packed[:n, s] = self._staged_rows[:n]
+                packed[:n, s + 1] = self._staged_flags[:n]
+            self._clear_staged()
+        t1 = time.perf_counter()
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
 
-            packed = jax.device_put(packed, NamedSharding(self.mesh, PartitionSpec()))
+            repl = NamedSharding(self.mesh, PartitionSpec())
+            packed = jax.device_put(packed, repl)
+            if acks is not None:
+                acks = jax.device_put(acks, repl)
         else:
             packed = jax.device_put(packed)
+            if acks is not None:
+                acks = jax.device_put(acks)
+        t2 = time.perf_counter()
         k = min(self.patch_capacity, self.B)
         self._state, wire = self._step(
-            self._state, packed, patch_capacity=k,
+            self._state, packed, acks, patch_capacity=k,
             use_pallas=self.use_pallas, mesh=self.mesh,
         )
         wire.copy_to_host_async()
+        t3 = time.perf_counter()
+        # a stale tick's t1-t0 is the whole-mirror device upload, not the
+        # steady-state pack — keep the histograms separable
+        _phase("full_upload" if was_stale else "pack", t1 - t0)
+        _phase("put", t2 - t1)
+        _phase("step_dispatch", t3 - t2)
         self.stats["ticks"] += 1
         return wire, (k, int(self._state.avail.shape[1]))
 
@@ -609,12 +745,22 @@ class FusedCore:
         #    migration) are stale: touching them would resurrect rows in
         #    the old bucket — drop them, the replacement section was
         #    re-enqueued with the same keys.
-        touched: dict[Section, set] = {}
-        for _oid, _side, key, section in items:
+        t0 = time.perf_counter()
+        # per key, remember WHICH side(s) this batch's events touched —
+        # an informer event changes exactly one mirror side (the
+        # reference's two controllers each watch one apiserver,
+        # pkg/syncer/specsyncer.go:43-55 / statussyncer.go:29-39), so an
+        # existing row ships only that side's wire entry; mask bit 1 = up,
+        # bit 2 = down
+        touched: dict[Section, dict] = {}
+        for _oid, side, key, section in items:
             if section is not None and not section.released:
-                touched.setdefault(section, set()).add(key)
-        for section, keys in touched.items():
-            self._encode_section(section, keys)
+                km = touched.setdefault(section, {})
+                km[key] = km.get(key, 0) | (2 if side else 1)
+        for section, keymasks in touched.items():
+            self._encode_section(section, keymasks)
+        if touched:
+            _phase("encode", time.perf_counter() - t0)
 
         # 2. one fused step per dirty bucket; collection is pipelined
         for bucket in self.buckets.values():
@@ -654,25 +800,72 @@ class FusedCore:
         self._schedule_flush()
         return []
 
-    def _encode_section(self, section: Section, keys) -> None:
+    def _encode_section(self, section: Section, keymasks: dict) -> None:
         from ..ops.encode import BucketOverflow
 
-        for key in keys:
-            try:
-                up_v, up_e, down_v, down_e = section.owner.fused_encode(key)
-            except BucketOverflow:
-                # engine's vocabulary outgrew this bucket: the engine
-                # re-registers in a larger bucket and replays its rows
-                section.owner.fused_overflow()
-                return
-            row = section.row_for(key)
-            section.bucket.stage(row, False, up_v, up_e)
-            section.bucket.stage(row, True, down_v, down_e)
+        bucket = section.bucket
+        keys = list(keymasks)
+        # a key new to the bucket must initialize BOTH device mirror
+        # sides; an existing row ships only the side(s) its events touched
+        masks = np.fromiter(
+            (keymasks[k] | (0 if k in section.rows else 3) for k in keys),
+            np.uint8, len(keys))
+        many = getattr(section.owner, "fused_encode_many", None)
+        try:
+            if many is not None:
+                up_v, up_e, down_v, down_e = many(keys)
+            else:
+                ups, upes, downs, downes = [], [], [], []
+                for key in keys:
+                    u, ue, dv, de = section.owner.fused_encode(key)
+                    ups.append(u)
+                    upes.append(ue)
+                    downs.append(dv)
+                    downes.append(de)
+                try:
+                    up_v, down_v = np.stack(ups), np.stack(downs)
+                except ValueError:
+                    # ragged widths (an engine mid-vocabulary-migration):
+                    # per-key slow path, both sides as before
+                    for key, u, ue, dv, de in zip(keys, ups, upes, downs,
+                                                  downes):
+                        row = section.row_for(key)
+                        bucket.stage(row, False, u, ue)
+                        bucket.stage(row, True, dv, de)
+                    section.refresh_mask()
+                    return
+                up_e = np.asarray(upes, bool)
+                down_e = np.asarray(downes, bool)
+        except BucketOverflow:
+            # engine's vocabulary outgrew this bucket: the engine
+            # re-registers in a larger bucket and replays its rows
+            section.owner.fused_overflow()
+            return
+        rows = np.fromiter((section.row_for(k) for k in keys),
+                           np.int64, len(keys))
+        up_v, up_e = np.asarray(up_v), np.asarray(up_e)
+        down_v, down_e = np.asarray(down_v), np.asarray(down_e)
+        up_sel = (masks & 1) != 0
+        if up_sel.all():
+            bucket.stage_many(rows, False, up_v, up_e)
+        elif up_sel.any():
+            bucket.stage_many(rows[up_sel], False, up_v[up_sel], up_e[up_sel])
+        down_sel = (masks & 2) != 0
+        if down_sel.all():
+            bucket.stage_many(rows, True, down_v, down_e)
+        elif down_sel.any():
+            bucket.stage_many(rows[down_sel], True, down_v[down_sel],
+                              down_e[down_sel])
         section.refresh_mask()
 
     def _collect(self, bucket: FusedBucket, wire: jax.Array,
                  meta: tuple[int, int]) -> None:
-        overflow = bucket.dispatch(np.asarray(wire), meta)
+        t0 = time.perf_counter()
+        host_wire = np.asarray(wire)
+        t1 = time.perf_counter()
+        overflow = bucket.dispatch(host_wire, meta)
+        _phase("collect_wait", t1 - t0)
+        _phase("dispatch", time.perf_counter() - t1)
         if overflow:
             # level-triggered: re-run the bucket with doubled capacity
             bucket.mark_stale()

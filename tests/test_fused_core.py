@@ -197,3 +197,82 @@ def test_core_refcount_across_syncers():
         assert core._refs == 0
 
     asyncio.run(main())
+
+
+def test_ack_lane_unit_padding_never_clobbers_row_zero():
+    """The converged-row acks lane: padding entries (-1) must scatter
+    NOTHING — a clip-to-zero implementation would overwrite row 0 (racing
+    its genuine ack, or reverting it outright) — while a real ack copies
+    the up mirror into the down mirror exactly."""
+    import jax
+    import numpy as np
+
+    from kcp_tpu.models.reconcile_model import (
+        example_state,
+        reconcile_step_packed,
+    )
+
+    base = example_state(b=64, s=16, r=8, p=8, l=4, c=8)
+    # force row 0 divergent so any padding write to it is detectable
+    down = np.asarray(base.down_vals).copy()
+    down[0] = 12345
+    base = base._replace(down_vals=down, down_exists=np.asarray(base.down_exists).copy())
+    packed = np.zeros((8, 16 + 2), np.uint32)
+    step = jax.jit(reconcile_step_packed, static_argnames=("patch_capacity",))
+
+    # 1. padding-only acks: row 0 must stay divergent (nothing scattered)
+    state = jax.tree.map(jax.device_put, base)
+    pad_only = np.full(8, -1, np.int32)
+    s1, _ = step(state, jax.device_put(packed), jax.device_put(pad_only),
+                 patch_capacity=16)
+    np.testing.assert_array_equal(np.asarray(s1.down_vals)[0], down[0])
+
+    # 2. a real ack for row 0 among padding: down becomes exactly up
+    state = jax.tree.map(jax.device_put, base)
+    acks = np.full(8, -1, np.int32)
+    acks[0] = 0
+    s2, _ = step(state, jax.device_put(packed), jax.device_put(acks),
+                 patch_capacity=16)
+    np.testing.assert_array_equal(np.asarray(s2.down_vals)[0],
+                                  np.asarray(base.up_vals)[0])
+    assert bool(np.asarray(s2.down_exists)[0])
+
+
+def test_ack_lane_compresses_feedback_and_stays_correct():
+    """End-to-end: the downstream echo of an applied sync rides the acks
+    lane (bucket.stats['acked'] grows) and the loop still converges both
+    an update and a subsequent delete."""
+
+    async def main():
+        kcp, phys = LogicalStore(), LogicalStore()
+        up, down = Client(kcp, "t"), Client(phys, "p")
+        syncer = await start_syncer(up, down, ["configmaps"], "c1", backend="tpu")
+        bucket = syncer.engines[0]._section.bucket
+
+        for i in range(16):
+            up.create("configmaps", cm(f"cm-{i}", {"v": str(i)}))
+        await eventually(lambda: len(down.list("configmaps")[0]) == 16)
+        # the downstream creates echo back as down-side events whose
+        # encoding equals the up mirror -> acks, not full entries
+        await eventually(lambda: bucket.stats["acked"] > 0)
+
+        obj = up.get("configmaps", "cm-3", "default")
+        obj["data"] = {"v": "updated"}
+        up.update("configmaps", obj)
+        await eventually(
+            lambda: down.get("configmaps", "cm-3", "default")["data"]["v"] == "updated")
+
+        up.delete("configmaps", "cm-5", "default")
+        from kcp_tpu.utils.errors import NotFoundError
+
+        def gone():
+            try:
+                down.get("configmaps", "cm-5", "default")
+                return False
+            except NotFoundError:
+                return True
+
+        await eventually(gone)
+        await syncer.stop()
+
+    asyncio.run(main())
