@@ -261,12 +261,10 @@ def main() -> int:
     # persistent XLA compilation cache: recompiles are seconds-long p99
     # spikes (and most of warmup); cache them across runs — including the
     # driver's end-of-round run. Repo-local so the artifact rides along.
-    os.environ.setdefault(
-        "KCP_COMPILE_CACHE",
-        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
     from kcp_tpu.cli import enable_compilation_cache
 
-    enable_compilation_cache()
+    enable_compilation_cache(default_path=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
 
     from kcp_tpu.syncer.core import FusedCore
 

@@ -33,15 +33,17 @@ def apply_platform_env() -> None:
     enable_compilation_cache()
 
 
-def enable_compilation_cache() -> None:
+def enable_compilation_cache(default_path: str | None = None) -> None:
     """Persistent XLA compilation cache for every binary: a recompile of
     the fused step is a seconds-long serving stall (p99 poison), and the
     cache also turns restart warmup from ~30 s of compiles into reads.
     Opt out with KCP_NO_COMPILE_CACHE=1; relocate with KCP_COMPILE_CACHE.
+    ``default_path`` overrides the built-in default (used by bench/tests
+    to keep the cache repo-local); the env var wins over both.
     """
     if os.environ.get("KCP_NO_COMPILE_CACHE") == "1":
         return
-    path = os.environ.get("KCP_COMPILE_CACHE") or os.path.join(
+    path = os.environ.get("KCP_COMPILE_CACHE") or default_path or os.path.join(
         os.path.expanduser("~"), ".cache", "kcp_tpu", "xla")
     try:
         import jax

@@ -66,6 +66,13 @@ log = logging.getLogger(__name__)
 def _phase(name: str, dt: float) -> None:
     """Record one tick-phase timing (histogram ``fused_<name>_seconds``).
 
+    The point-sample form of :func:`kcp_tpu.utils.trace.span` — used here
+    because the tick segments (pack/put/step) share perf_counter points
+    across branches and a with-block per segment cannot express that, and
+    because some phases must record only on qualifying ticks (encode only
+    when keys were touched) to keep the means meaningful. Same registry,
+    same naming convention as span.
+
     The per-phase breakdown is the 'where does tick time go' answer the
     /debug/profile surface and bench.py report; keep observations cheap —
     one perf_counter pair per phase per tick, never per row."""
@@ -515,7 +522,7 @@ class FusedBucket:
             # full upload replaces the mirrors wholesale; still run the
             # step so decisions for the new state come back
             packed = np.zeros((MIN_EVENTS, s + 2), np.uint32)
-            acks = None
+            acks = np.full(self.ack_capacity, -1, np.int32)
         else:
             if self._pl_staged:
                 # placement inputs changed (roots staged/retired): swap
@@ -543,18 +550,22 @@ class FusedBucket:
             nf = n - na
             d = pad_pow2(nf, floor=MIN_EVENTS)
             packed = np.zeros((d, s + 2), np.uint32)
+            # always ship the acks array, even all-padding: an acks=None
+            # fast path would be a SECOND jit trace variant, and the
+            # first ack-bearing tick would then compile it mid-serving —
+            # a seconds-long loop stall (measured) vs the ~nothing an
+            # all-dropped scatter pass costs per tick
+            while self.ack_capacity < na:
+                self.ack_capacity *= 2
+            acks = np.full(self.ack_capacity, -1, np.int32)
             if na:
                 self.stats["acked"] += na
-                while self.ack_capacity < na:
-                    self.ack_capacity *= 2
-                acks = np.full(self.ack_capacity, -1, np.int32)
                 full_sel = ~ack_sel
                 packed[:nf, :s] = self._staged_vals[:n][full_sel]
                 packed[:nf, s] = self._staged_rows[:n][full_sel]
                 packed[:nf, s + 1] = self._staged_flags[:n][full_sel]
                 acks[:na] = self._staged_rows[:n][ack_sel]
             else:
-                acks = None  # its own trace-time variant: no scatter pass
                 packed[:n, :s] = self._staged_vals[:n]
                 packed[:n, s] = self._staged_rows[:n]
                 packed[:n, s + 1] = self._staged_flags[:n]
@@ -565,12 +576,10 @@ class FusedBucket:
 
             repl = NamedSharding(self.mesh, PartitionSpec())
             packed = jax.device_put(packed, repl)
-            if acks is not None:
-                acks = jax.device_put(acks, repl)
+            acks = jax.device_put(acks, repl)
         else:
             packed = jax.device_put(packed)
-            if acks is not None:
-                acks = jax.device_put(acks)
+            acks = jax.device_put(acks)
         t2 = time.perf_counter()
         k = min(self.patch_capacity, self.B)
         self._state, wire = self._step(
@@ -641,6 +650,7 @@ class FusedCore:
         )
         self._inflight: list[tuple[FusedBucket, jax.Array]] = []
         self._flush_task: asyncio.Task | None = None
+        self._eager_collect: bool | None = None  # resolved on first flush
         self._refs = 0
         self._started = False
         self._loop = None
@@ -877,15 +887,38 @@ class FusedCore:
         self._flush_task = asyncio.create_task(self._idle_flush())
 
     async def _idle_flush(self) -> None:
-        """Collect remaining in-flight wires once the loop goes quiet —
-        without this, the last tick's patches would wait for the next
-        informer event."""
+        """Collect in-flight wires off the tick path.
+
+        On an asynchronous backend (TPU), this polls ``wire.is_ready()``
+        between ticks and collects the moment the device finishes —
+        patches apply ~one device round trip after dispatch instead of
+        waiting for the NEXT tick's depth-based collect (about a full
+        tick of convergence latency under continuous load), and it never
+        blocks a submit because only ready wires are popped. On the
+        synchronous CPU backend every wire is instantly "ready", so eager
+        collection would serialize dispatch into the loop (measured ~15%
+        of serving throughput) — there, keep the original behavior: only
+        collect once the loop has been quiet for IDLE_FLUSH_S (without
+        which the last tick's patches would wait for the next informer
+        event)."""
+        if self._eager_collect is None:
+            try:
+                self._eager_collect = jax.default_backend() != "cpu"
+            except Exception:  # noqa: BLE001 — backend init failure
+                self._eager_collect = False
         try:
-            await asyncio.sleep(IDLE_FLUSH_S)
+            if not self._eager_collect:
+                await asyncio.sleep(IDLE_FLUSH_S)
             while self._inflight:
                 bucket, wire, meta = self._inflight[0]
                 while not wire.is_ready():
                     await asyncio.sleep(0.001)
+                # the head can change across the awaits (a tick's depth-
+                # based collect pops it, and a collect failure means
+                # _schedule_flush never cancelled this task) — pop only
+                # the wire this iteration actually inspected
+                if not self._inflight or self._inflight[0][1] is not wire:
+                    continue
                 self._inflight.pop(0)
                 self._collect(bucket, wire, meta)
         except asyncio.CancelledError:

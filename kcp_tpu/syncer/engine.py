@@ -280,7 +280,6 @@ class BatchSyncEngine:
     async def _apply_worker(self) -> None:
         while True:
             key, code, upsync = await self._apply_q.get()
-            self._apply_pending.discard(key)
             try:
                 applied = await self._apply_async(key, code, upsync)
             except Exception as err:  # noqa: BLE001 — reconcile errors are data
@@ -290,6 +289,13 @@ class BatchSyncEngine:
                 if applied:
                     self.stats["decisions_applied"] += 1
             finally:
+                # pending holds until the apply FINISHES: a slow apply
+                # must suppress the level-triggered re-patches every tick
+                # emits for its still-divergent row, or duplicates of one
+                # slow key eat the whole worker pool. Anything that
+                # changed mid-apply is recovered by the next tick — the
+                # row is still divergent, pending is clear, it re-patches
+                self._apply_pending.discard(key)
                 self._apply_q.task_done()
 
     async def _apply_async(self, key, code: int, upsync: bool) -> bool:

@@ -27,6 +27,15 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# the same persistent XLA compile cache the binaries use: recompiles of
+# the fused step would otherwise dominate cold isolated test runs (and a
+# compile landing inside a latency-bounded test is exactly the stall the
+# cache exists to prevent in production)
+from kcp_tpu.cli import enable_compilation_cache  # noqa: E402
+
+enable_compilation_cache(default_path=os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"))
+
 
 def pytest_sessionstart(session):
     # fail fast if the platform override did not take: a hung TPU tunnel
