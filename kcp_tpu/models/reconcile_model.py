@@ -131,12 +131,19 @@ def reconcile_step(state: ReconcileState, deltas: ReconcileDeltas,
         # (ops/pallas_kernels.py; differential-tested vs the XLA lanes).
         # On a mesh the kernel runs per device on its local row block via
         # shard_map (counts psum across the row axes). block_rows must
-        # DIVIDE the local rows: pick the largest pow2 multiple of the
-        # 128-lane width that does (128 always works given the gate)
-        from ..ops.pallas_kernels import decide_and_match, decide_and_match_sharded
+        # DIVIDE the local rows AND fit the measured scoped-VMEM budget
+        # for this slot width (max_block_rows; 128 always divides given
+        # the gate, but a very wide bucket can fail the VMEM cap)
+        from ..ops.pallas_kernels import (
+            decide_and_match,
+            decide_and_match_sharded,
+            max_block_rows,
+        )
 
-        br = next(k for k in (4096, 2048, 1024, 512, 256, 128)
-                  if local_b % k == 0)
+        br = max_block_rows(local_b, up_vals.shape[1])
+    else:
+        br = 0
+    if use_pallas and br:
         if mesh is not None:
             decision, status_upsync, match_counts = decide_and_match_sharded(
                 mesh, up_vals, up_exists, down_vals, down_exists,

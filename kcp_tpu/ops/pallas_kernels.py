@@ -46,6 +46,24 @@ DECISION_DELETE = 3
 
 _LANES = 128  # rows per plane row; B must divide by it on TPU
 
+# measured scoped-VMEM-safe budget: br=2048 at S=64 fits the 16 MB limit
+# with headroom on a v5e (4096 allocates ~24 MB and OOMs, hardware-
+# verified); scale the row cap inversely with slot width
+_VMEM_ROW_SLOTS = 2048 * 64
+
+
+def max_block_rows(local_rows: int, slots: int) -> int:
+    """Largest block_rows that divides ``local_rows``, is a multiple of
+    the 128-lane width, and fits the measured scoped-VMEM budget for
+    ``slots``-wide rows. 0 if none qualifies (caller falls back to the
+    XLA lanes)."""
+    cap = _VMEM_ROW_SLOTS // max(slots, 1)
+    for k in (2048, 1024, 512, 256, 128):
+        if k <= cap and local_rows % k == 0:
+            return k
+    # even a 128-row block exceeds the budget (slots > 1024): XLA lanes
+    return 0
+
 
 def default_interpret() -> bool:
     """Whether decide_and_match will run under the Pallas interpreter by
@@ -110,11 +128,17 @@ def decide_and_match(
     status_mask: jax.Array,  # bool [S] bucket-wide or [B, S] per-row
     pair_hashes: jax.Array,  # uint32 [B, L]
     sel_hashes: jax.Array,   # uint32 [C]
-    block_rows: int = 4096,
+    block_rows: int = 2048,
     interpret: bool | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Fused decision + fan-out: (decision u8 [B], upsync bool [B],
     match_counts int32 [C]).
+
+    ``block_rows`` defaults to the measured scoped-VMEM-safe block for
+    S=64 on a v5e: 4096-row blocks compile to a ~24 MB scoped allocation
+    against the 16 MB limit (hardware-verified OOM), 2048 fits with
+    headroom. Use :func:`max_block_rows` to scale the cap for wider
+    buckets.
 
     Matches ops.diff.sync_decisions + ops.labelmatch.fanout_match
     (fan-out counted over resident upstream rows), differential-tested
@@ -199,7 +223,7 @@ def decide_and_match_sharded(
     status_mask: jax.Array,  # bool [S] replicated or [B, S] row-sharded
     pair_hashes: jax.Array,  # uint32 [B, L]
     sel_hashes: jax.Array,   # uint32 [C] replicated
-    block_rows: int = 4096,
+    block_rows: int = 2048,
     interpret: bool | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """The fused pass on a sharded bucket: shard_map runs the Pallas

@@ -361,3 +361,18 @@ class TestShardedPallas:
                          static_argnames=("use_pallas", "mesh"))(state, deltas)
         np.testing.assert_array_equal(np.asarray(out.decision),
                                       np.asarray(ref.decision))
+
+
+def test_max_block_rows_vmem_cap():
+    """The block selector honors the measured scoped-VMEM budget: wider
+    buckets get smaller blocks, and a bucket too wide for even a 128-row
+    block falls back to the XLA lanes (0)."""
+    from kcp_tpu.ops.pallas_kernels import max_block_rows
+
+    assert max_block_rows(131072, 64) == 2048
+    assert max_block_rows(131072, 128) == 1024
+    assert max_block_rows(131072, 1024) == 128
+    assert max_block_rows(131072, 2048) == 0  # over budget at any block
+    # divisibility: block must divide the local rows
+    assert max_block_rows(1024 + 128, 64) == 128
+    assert max_block_rows(100, 64) == 0  # not 128-divisible
