@@ -140,7 +140,9 @@ def reconcile_step(state: ReconcileState, deltas: ReconcileDeltas,
             max_block_rows,
         )
 
-        br = max_block_rows(local_b, up_vals.shape[1])
+        br = max_block_rows(local_b, up_vals.shape[1],
+                            labels=state.pair_hashes.shape[1],
+                            per_row_mask=state.status_mask.ndim == 2)
     else:
         br = 0
     if use_pallas and br:

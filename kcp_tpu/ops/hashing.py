@@ -16,6 +16,7 @@ future C++ encoder) agree byte-for-byte.
 from __future__ import annotations
 
 import json
+from functools import lru_cache
 from typing import Any
 
 FNV_OFFSET = 0x811C9DC5
@@ -31,7 +32,11 @@ def fnv1a(data: bytes, seed: int = FNV_OFFSET) -> int:
     return h
 
 
+@lru_cache(maxsize=65536)
 def hash_str(s: str) -> int:
+    # memoized: schema keys and label names repeat across thousands of
+    # objects, and the pure-python FNV byte loop dominates tokenization
+    # otherwise (the suite's schema-bucketing lane measured it)
     return fnv1a(s.encode("utf-8"))
 
 
@@ -40,11 +45,31 @@ def canonical_json(value: Any) -> str:
     return json.dumps(value, sort_keys=True, separators=(",", ":"), ensure_ascii=False)
 
 
+@lru_cache(maxsize=65536)
+def _hash_scalar(type_name: str, value) -> int:
+    # type_name disambiguates python equality collisions (True == 1 and
+    # hash(True) == hash(1), but canonical_json renders "true" vs "1" —
+    # a bare value-keyed cache would alias them)
+    h = fnv1a(canonical_json(value).encode("utf-8"))
+    return h if h != 0 else 1
+
+
 def hash_value(value: Any) -> int:
     """Hash a JSON leaf (or subtree) value; never returns 0.
 
-    0 is reserved as the "absent" sentinel in encoded tensors.
+    0 is reserved as the "absent" sentinel in encoded tensors. Scalar
+    leaves are memoized (enum members, type names, and common field
+    values repeat endlessly across a fleet's objects and schemas);
+    dict/list subtrees hash uncached.
     """
+    if value is None or isinstance(value, (str, int, float, bool)):
+        if isinstance(value, float) and value == 0.0:
+            # -0.0 == 0.0 with equal python hashes, but canonical_json
+            # renders them "-0.0" vs "0.0" — a cache key would alias
+            # them and make the hash first-caller-dependent across hosts
+            h = fnv1a(canonical_json(value).encode("utf-8"))
+            return h if h != 0 else 1
+        return _hash_scalar(type(value).__name__, value)
     h = fnv1a(canonical_json(value).encode("utf-8"))
     return h if h != 0 else 1
 

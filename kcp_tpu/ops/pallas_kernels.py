@@ -46,22 +46,28 @@ DECISION_DELETE = 3
 
 _LANES = 128  # rows per plane row; B must divide by it on TPU
 
-# measured scoped-VMEM-safe budget: br=2048 at S=64 fits the 16 MB limit
-# with headroom on a v5e (4096 allocates ~24 MB and OOMs, hardware-
-# verified); scale the row cap inversely with slot width
-_VMEM_ROW_SLOTS = 2048 * 64
+# measured scoped-VMEM-safe budget in per-block row-words: at S=64, L=8,
+# per-row mask, a block row loads ~3S+L = 200 uint32 words (up + down +
+# mask + pair hashes); br=2048 (409,600 words) fits the v5e's 16 MB
+# scoped limit with headroom while br=4096 allocates ~24 MB and OOMs
+# (hardware-verified). The budget is calibrated to that safe point.
+_VMEM_WORD_BUDGET = 2048 * 200
 
 
-def max_block_rows(local_rows: int, slots: int) -> int:
+def max_block_rows(local_rows: int, slots: int, labels: int = 0,
+                   per_row_mask: bool = True) -> int:
     """Largest block_rows that divides ``local_rows``, is a multiple of
     the 128-lane width, and fits the measured scoped-VMEM budget for
-    ``slots``-wide rows. 0 if none qualifies (caller falls back to the
-    XLA lanes)."""
-    cap = _VMEM_ROW_SLOTS // max(slots, 1)
+    this row footprint — ``slots``-wide value mirrors (×2), the status
+    mask (per-row form loads another ``slots`` column), and the
+    ``labels``-wide pair hashes all ride in the same block. 0 if none
+    qualifies (caller falls back to the XLA lanes)."""
+    words = (3 if per_row_mask else 2) * max(slots, 1) + labels
+    cap = _VMEM_WORD_BUDGET // words
     for k in (2048, 1024, 512, 256, 128):
         if k <= cap and local_rows % k == 0:
             return k
-    # even a 128-row block exceeds the budget (slots > 1024): XLA lanes
+    # even a 128-row block exceeds the budget: XLA lanes
     return 0
 
 

@@ -369,10 +369,15 @@ def test_max_block_rows_vmem_cap():
     block falls back to the XLA lanes (0)."""
     from kcp_tpu.ops.pallas_kernels import max_block_rows
 
-    assert max_block_rows(131072, 64) == 2048
-    assert max_block_rows(131072, 128) == 1024
+    assert max_block_rows(131072, 64, labels=8) == 2048
+    assert max_block_rows(131072, 128, labels=8) == 1024
     assert max_block_rows(131072, 1024) == 128
     assert max_block_rows(131072, 2048) == 0  # over budget at any block
+    # wide label capacity eats the same budget (review finding: L rides
+    # in the block too)
+    assert max_block_rows(131072, 64, labels=512) == 512
+    # the bucket-wide [S] mask form loads one fewer slots column
+    assert max_block_rows(131072, 1536, per_row_mask=False) == 128
     # divisibility: block must divide the local rows
     assert max_block_rows(1024 + 128, 64) == 128
     assert max_block_rows(100, 64) == 0  # not 128-divisible
