@@ -67,9 +67,13 @@ import numpy as np
 TARGET_POD = 1_000_000  # BASELINE.json: v5e-8
 TARGET_CHIP = TARGET_POD // 8
 
-# measurement shape
-TENANTS = 10_000
-B = 131_072  # ~13 objects per logical cluster, pow2-padded
+# measurement shape. KCP_BENCH_ROWS widens the resident fleet for scale-
+# headroom runs (the reference's shard-capacity investigation targets
+# ~100k objects per shard, logical-clusters.md:83; the default already
+# exceeds it and the loop holds 1M+ rows on one chip) — the driver's
+# default run is unchanged.
+B = int(os.environ.get("KCP_BENCH_ROWS", "131072"))  # pow2
+TENANTS = B // 13  # ~13 objects per logical cluster
 S = 64
 CHURN = 768  # new upstream-spec events per tick
 WARMUP_TICKS = 24

@@ -295,10 +295,11 @@ def reconcile_step_packed(state: ReconcileState, packed: jax.Array,
     Output layout: [0]=patch count, [1]=overflow flag, [2:10]=stats,
     [PACK_HDR:]=packed patch entries (see module comment).
     """
-    if state.up_vals.shape[0] > PACK_IDX_MASK:
+    if state.up_vals.shape[0] > PACK_IDX_MASK + 1:
+        # row indices go up to B-1, so B == 2^20 exactly fits the field
         raise ValueError(
             f"packed patch entries hold 20-bit row indices; "
-            f"B={state.up_vals.shape[0]} exceeds {PACK_IDX_MASK} — "
+            f"B={state.up_vals.shape[0]} exceeds {PACK_IDX_MASK + 1} — "
             f"shard the bucket or use the unpacked ReconcileOutputs lanes"
         )
     if acks is not None and state.up_vals.shape[0] > 0:
