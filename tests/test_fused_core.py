@@ -276,3 +276,61 @@ def test_ack_lane_compresses_feedback_and_stays_correct():
         await syncer.stop()
 
     asyncio.run(main())
+
+
+def test_idle_flush_head_guard_survives_collect_failure():
+    """If a tick's depth-based collect pops the in-flight head and FAILS
+    (so _schedule_flush never cancels the parked flusher), the resumed
+    flusher must not collect its stale captured tuple against a
+    different head wire (eager-collect review finding)."""
+
+    async def main():
+        import numpy as np
+
+        from kcp_tpu.syncer.core import FusedCore
+
+        core = FusedCore(batch_window=0.0005)
+        core._eager_collect = True  # force the eager path on CPU
+
+        collected = []
+
+        class FakeBucket:
+            def dispatch(self, wire, meta):
+                collected.append(int(np.asarray(wire)[0]))
+                return False
+
+        class FakeWire:
+            def __init__(self, tag):
+                self.tag = tag
+                self.ready = False
+
+            def is_ready(self):
+                return self.ready
+
+            def __array__(self, dtype=None, copy=None):
+                return np.array([self.tag])
+
+        b = FakeBucket()
+        wire_a, wire_b = FakeWire(1), FakeWire(2)
+        core._inflight = [(b, wire_a, (0, 8)), (b, wire_b, (0, 8))]
+        # park the flusher in its not-ready poll, holding the head tuple
+        core._schedule_flush()
+        await asyncio.sleep(0.005)
+        assert core._inflight  # parked, nothing collected yet
+        # simulate the tick's own collect popping wire_a while the
+        # flusher is parked (the failure case leaves it uncancelled)
+        head = core._inflight.pop(0)
+        core._collect(*head)
+        wire_a.ready = wire_b.ready = True
+        # let the parked flusher resume: it must collect wire_b (the new
+        # head), never its stale wire_a capture against wire_b's slot
+        for _ in range(20):
+            await asyncio.sleep(0.002)
+            if not core._inflight:
+                break
+        assert collected == [1, 2], collected
+        assert not core._inflight
+        if core._flush_task is not None:
+            core._flush_task.cancel()
+
+    asyncio.run(main())
