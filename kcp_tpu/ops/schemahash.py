@@ -101,8 +101,15 @@ schema_hashes_jit = jax.jit(schema_hashes)
 
 
 def bucket_by_hash(hashes: np.ndarray) -> dict[int, np.ndarray]:
-    """Host-side: group row indices by hash value."""
-    out: dict[int, list[int]] = {}
-    for i, h in enumerate(np.asarray(hashes)):
-        out.setdefault(int(h), []).append(i)
-    return {h: np.array(idx, dtype=np.int32) for h, idx in out.items()}
+    """Host-side: group row indices by hash value (one argsort + one
+    boundary scan instead of a per-row python loop — at 5k tenant CRD
+    sets the loop was ~10x the device hash itself)."""
+    h = np.asarray(hashes)
+    if h.size == 0:
+        return {}
+    order = np.argsort(h, kind="stable").astype(np.int32)
+    sorted_h = h[order]
+    # boundaries of equal-hash runs in the sorted order
+    starts = np.flatnonzero(np.r_[True, sorted_h[1:] != sorted_h[:-1]])
+    ends = np.r_[starts[1:], sorted_h.size]
+    return {int(sorted_h[s]): order[s:e] for s, e in zip(starts, ends)}

@@ -72,3 +72,21 @@ def test_hash_spread():
     )
     h = np.asarray(schema_hashes_jit(toks))
     assert len(np.unique(h)) == 1000
+
+
+def test_bucket_by_hash_empty_and_parity():
+    import numpy as np
+
+    from kcp_tpu.ops.schemahash import bucket_by_hash
+
+    assert bucket_by_hash(np.asarray([], dtype=np.uint32)) == {}
+    rng = np.random.default_rng(0)
+    h = rng.integers(0, 50, 5000).astype(np.uint32)
+    got = bucket_by_hash(h)
+    ref: dict = {}
+    for i, v in enumerate(h):
+        ref.setdefault(int(v), []).append(i)
+    assert set(got) == set(ref)
+    for k, idx in ref.items():
+        # stable: ascending row order inside each bucket, like the loop
+        assert got[k].tolist() == idx
