@@ -75,10 +75,6 @@ def test_hash_spread():
 
 
 def test_bucket_by_hash_empty_and_parity():
-    import numpy as np
-
-    from kcp_tpu.ops.schemahash import bucket_by_hash
-
     assert bucket_by_hash(np.asarray([], dtype=np.uint32)) == {}
     rng = np.random.default_rng(0)
     h = rng.integers(0, 50, 5000).astype(np.uint32)
