@@ -261,8 +261,14 @@ def pack_deltas(deltas: ReconcileDeltas) -> np.ndarray:
     )
 
 
+MASK_STAMP_BIT = 8  # flag: entry carries a status-mask row, not a delta
+
+
 def unpack_deltas(packed: jax.Array) -> ReconcileDeltas:
-    """Device-side (inside jit): unpack the uint32 [D, S+2] wire array."""
+    """Device-side (inside jit): unpack the uint32 [D, S+2] wire array.
+
+    Mask-stamp entries (flag bit 8) are not deltas — they are excluded
+    from ``valid`` here and consumed by :func:`apply_mask_stamps`."""
     s = packed.shape[1] - 2
     flags = packed[:, s + 1]
     return ReconcileDeltas(
@@ -270,8 +276,31 @@ def unpack_deltas(packed: jax.Array) -> ReconcileDeltas:
         vals=packed[:, :s],
         exists=(flags & 1) != 0,
         side=(flags & 2) != 0,
-        valid=(flags & 4) != 0,
+        valid=((flags & 4) != 0) & ((flags & MASK_STAMP_BIT) == 0),
     )
+
+
+def apply_mask_stamps(status_mask: jax.Array, packed: jax.Array) -> jax.Array:
+    """Scatter mask-stamp entries into the per-row status mask.
+
+    A row allocated AFTER its bucket's last full upload has a host-side
+    mask stamp (Section.row_for) that the device never saw — the delta
+    wire carries values only. Without this lane the device's mask for
+    such a row stays all-False, its status churn misreads as spec churn
+    (UPDATE instead of upsync), the applier correctly no-ops the
+    phantom UPDATE, and the object never converges — found by the
+    randomized differential fuzz. Stamps ride the same packed array:
+    flag bit 8, vals columns = the bool mask row.
+    """
+    if status_mask.ndim != 2:
+        return status_mask  # bucket-wide [S] masks have no per-row lane
+    b = status_mask.shape[0]
+    s = packed.shape[1] - 2
+    flags = packed[:, s + 1]
+    sel = ((flags & 4) != 0) & ((flags & MASK_STAMP_BIT) != 0)
+    idx = packed[:, s].astype(jnp.int32)
+    tgt = jnp.where(sel, idx, b)  # non-stamp entries route OOB -> drop
+    return status_mask.at[tgt].set(packed[:, :s] != 0, mode="drop")
 
 
 def reconcile_step_packed(state: ReconcileState, packed: jax.Array,
@@ -315,6 +344,8 @@ def reconcile_step_packed(state: ReconcileState, packed: jax.Array,
         down_exists = state.down_exists.at[idx].set(
             state.up_exists[gather], mode="drop")
         state = state._replace(down_vals=down_vals, down_exists=down_exists)
+    state = state._replace(
+        status_mask=apply_mask_stamps(state.status_mask, packed))
     new_state, out = reconcile_step(state, unpack_deltas(packed), patch_capacity,
                                     use_pallas=use_pallas, mesh=mesh)
     entries = (

@@ -50,6 +50,7 @@ import jax
 import numpy as np
 
 from ..models.reconcile_model import (
+    MASK_STAMP_BIT,
     PACK_HDR,
     ReconcileState,
     reconcile_step_packed,
@@ -130,6 +131,12 @@ class Section:
             # stamp with the cached mask; refresh_mask restamps everything
             # if the owner's vocabulary has drifted since
             self.bucket.status_mask[row, : self._mask.shape[0]] = self._mask
+            # the DEVICE must see this stamp too: the delta wire carries
+            # values only, and without a mask stamp a row allocated after
+            # the last full upload reads its status churn as spec churn
+            # forever (fuzz-found) — ship it as a wire entry
+            if self._mask.any():
+                self.bucket.stage_mask(row, self.bucket.status_mask[row])
         return row
 
     def refresh_mask(self) -> None:
@@ -223,6 +230,9 @@ class FusedBucket:
         # as a 4-byte row index instead of an (S+2)-column entry
         self._staged_ack = np.zeros(0, bool)
         self._staged_n = 0
+        # mask stamps for rows allocated since the last full upload
+        # (row -> bool[S]); ride the packed wire as MASK_STAMP entries
+        self._staged_masks: dict[int, np.ndarray] = {}
         # acks-lane wire capacity: sticky high-water doubling, so the
         # (packed, acks) shape pair stays stable after warmup — per-tick
         # pow2 padding here would multiply compiled-shape variants. The
@@ -397,6 +407,12 @@ class FusedBucket:
         if n:
             self._staged_slot[self._staged_keys[:n]] = -1
             self._staged_n = 0
+        self._staged_masks.clear()
+
+    def stage_mask(self, row: int, mask: np.ndarray) -> None:
+        """Stage a status-mask stamp for a newly-allocated row (ships as
+        a MASK_STAMP wire entry; a full upload supersedes it)."""
+        self._staged_masks[row] = mask.copy()
 
     def stage(self, row: int, side: bool, vals: np.ndarray, exists: bool) -> None:
         """Stage one delta event (last-wins per (row, side)) and mirror it
@@ -469,7 +485,8 @@ class FusedBucket:
 
     @property
     def dirty(self) -> bool:
-        return bool(self._staged_n) or self._stale or self._pl_staged
+        return (bool(self._staged_n) or bool(self._staged_masks)
+                or self._stale or self._pl_staged)
 
     # -------------------------------------------------------------- tick
 
@@ -543,12 +560,15 @@ class FusedBucket:
             # the staged buffers already hold the packed-wire layout
             # (vals / row / flags, the unpack_deltas format) — one padded
             # block copy and a reset of the slot map finish the pack.
-            # Ack-eligible slots ship on the 4-byte acks lane instead.
+            # Ack-eligible slots ship on the 4-byte acks lane instead;
+            # mask stamps for newly-allocated rows append as MASK_STAMP
+            # entries (vals columns = the bool mask row).
             n = self._staged_n
             ack_sel = self._staged_ack[:n]
             na = int(ack_sel.sum())
             nf = n - na
-            d = pad_pow2(nf, floor=MIN_EVENTS)
+            nm = len(self._staged_masks)
+            d = pad_pow2(nf + nm, floor=MIN_EVENTS)
             packed = np.zeros((d, s + 2), np.uint32)
             # always ship the acks array, even all-padding: an acks=None
             # fast path would be a SECOND jit trace variant, and the
@@ -569,6 +589,12 @@ class FusedBucket:
                 packed[:n, :s] = self._staged_vals[:n]
                 packed[:n, s] = self._staged_rows[:n]
                 packed[:n, s + 1] = self._staged_flags[:n]
+            if nm:
+                mrows = np.fromiter(self._staged_masks, np.uint32, nm)
+                masks = np.stack(list(self._staged_masks.values()))
+                packed[nf:nf + nm, : masks.shape[1]] = masks.astype(np.uint32)
+                packed[nf:nf + nm, s] = mrows
+                packed[nf:nf + nm, s + 1] = 4 | MASK_STAMP_BIT
             self._clear_staged()
         t1 = time.perf_counter()
         if self.mesh is not None:

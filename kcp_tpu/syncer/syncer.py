@@ -50,12 +50,19 @@ class Syncer:
         cluster_id: str,
         backend: str = "tpu",
         mesh=None,
+        resync_period: float | None = None,
     ):
         self.cluster_id = cluster_id
         self.resources = list(resources)
+        kw = {}
+        if resync_period is not None:
+            # the missed-event / dropped-key safety net (reference:
+            # resyncPeriod, pkg/syncer/syncer.go:27) — tunable from the
+            # top-level API so operators can trade heal latency for churn
+            kw["resync_period"] = resync_period
         self.engines = [
             BatchSyncEngine(upstream, downstream, gvr, cluster_id,
-                            backend=backend, mesh=mesh)
+                            backend=backend, mesh=mesh, **kw)
             for gvr in resources
         ]
         self._started = False
@@ -103,6 +110,7 @@ async def start_syncer(
     cluster_id: str,
     backend: str = "tpu",
     mesh=None,
+    resync_period: float | None = None,
 ) -> Syncer:
     """Push-mode entry point (reference: StartSyncer, syncer.go:46-64).
 
@@ -113,6 +121,6 @@ async def start_syncer(
     """
     discover_gvrs(upstream, resources)
     s = Syncer(upstream, downstream, resources, cluster_id, backend=backend,
-               mesh=mesh)
+               mesh=mesh, resync_period=resync_period)
     await s.start()
     return s

@@ -1,0 +1,116 @@
+"""Randomized differential fuzz: tpu and host backends must converge a
+random op sequence to IDENTICAL state.
+
+The fixed-pattern differential test (test_syncer_e2e) covers the happy
+paths; this drives seeded random interleavings of the whole op
+vocabulary — create (labeled and unlabeled), update, delete, label
+flip-off/flip-on (placement unassign/assign), and downstream status
+writes (upsync) — and asserts both backends land on byte-identical
+converged state. A short resync period is part of the scenario: racing
+ops legitimately exhaust some keys' apply-retry budgets (the
+reference's 5-retries-then-drop), and the informer resync is the
+mechanism that heals the drops — the fuzz proves that recovery path
+end to end. Any divergence is a decision-lane bug by construction:
+the backends share the store, informers, and applier; only the decision
+math differs (SURVEY.md §7.1's differential-testing seam).
+"""
+
+import asyncio
+import random
+
+import pytest
+
+from kcp_tpu.client import Client
+from kcp_tpu.store import LogicalStore
+from kcp_tpu.syncer import start_syncer
+from kcp_tpu.syncer.engine import CLUSTER_LABEL
+
+POOL = 24  # distinct object names
+OPS = 120
+
+
+def _cm(name, v, labeled=True):
+    labels = {CLUSTER_LABEL: "c1"} if labeled else {}
+    return {"apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": name, "namespace": "default",
+                         "labels": labels},
+            "data": {"v": str(v)}}
+
+
+async def _run_backend(backend: str, seed: int):
+    rng = random.Random(seed)
+    kcp, phys = LogicalStore(), LogicalStore()
+    up, down = Client(kcp, "t"), Client(phys, "p")
+    syncer = await start_syncer(up, down, ["configmaps"], "c1",
+                                backend=backend, resync_period=1.5)
+    for step in range(OPS):
+        name = f"cm-{rng.randrange(POOL)}"
+        op = rng.random()
+        try:
+            if op < 0.30:
+                up.create("configmaps", _cm(name, step,
+                                            labeled=rng.random() < 0.85))
+            elif op < 0.55:
+                o = up.get("configmaps", name, "default")
+                o["data"] = {"v": str(step)}
+                up.update("configmaps", o)
+            elif op < 0.70:
+                up.delete("configmaps", name, "default")
+            elif op < 0.85:
+                # label flip: unassign or (re)assign placement
+                o = up.get("configmaps", name, "default")
+                labels = o["metadata"].get("labels") or {}
+                if CLUSTER_LABEL in labels:
+                    labels.pop(CLUSTER_LABEL)
+                else:
+                    labels[CLUSTER_LABEL] = "c1"
+                o["metadata"]["labels"] = labels
+                up.update("configmaps", o)
+            else:
+                # downstream status write -> upsync
+                d = down.get("configmaps", name, "default")
+                d["status"] = {"observed": str(step)}
+                down.update_status("configmaps", d)
+        except Exception:
+            # racing our own ops (not-found, conflict) is part of the fuzz
+            pass
+        if step % 8 == 0:
+            await asyncio.sleep(0.01)
+
+    def converged():
+        up_items = {o["metadata"]["name"]: o for o in up.list("configmaps")[0]
+                    if (o["metadata"].get("labels") or {})
+                    .get(CLUSTER_LABEL) == "c1"}
+        down_items = {o["metadata"]["name"]: o
+                      for o in down.list("configmaps")[0]}
+        if set(up_items) != set(down_items):
+            return False
+        for name, u in up_items.items():
+            d = down_items[name]
+            if u["data"] != d["data"]:
+                return False
+            if d.get("status") != u.get("status"):
+                return False
+        return True
+
+    deadline = asyncio.get_event_loop().time() + 20
+    while not converged():
+        if asyncio.get_event_loop().time() > deadline:
+            break
+        await asyncio.sleep(0.02)
+    assert converged(), f"{backend} seed={seed} did not converge"
+    state = sorted(
+        (o["metadata"]["name"], str(o["data"]), str(o.get("status")))
+        for o in down.list("configmaps")[0])
+    await syncer.stop()
+    return state
+
+
+@pytest.mark.parametrize("seed", [3, 11, 42])
+def test_randomized_churn_differential(seed):
+    async def main():
+        tpu_state = await _run_backend("tpu", seed)
+        host_state = await _run_backend("host", seed)
+        assert tpu_state == host_state
+
+    asyncio.run(main())

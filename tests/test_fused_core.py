@@ -334,3 +334,45 @@ def test_idle_flush_head_guard_survives_collect_failure():
             core._flush_task.cancel()
 
     asyncio.run(main())
+
+
+def test_mask_stamp_wire_entry_updates_device_mask():
+    """A MASK_STAMP entry (flag bit 8) must scatter into the per-row
+    status mask and NOT apply as a delta; the stamped row's status-only
+    divergence then decides upsync, not UPDATE (the fuzz-found bug)."""
+    import jax
+    import numpy as np
+
+    from kcp_tpu.models.reconcile_model import (
+        MASK_STAMP_BIT,
+        example_state,
+        reconcile_step_packed,
+        unpack_patches,
+    )
+
+    s = 16
+    base = example_state(b=64, s=s, r=8, p=8, l=4, c=8, dirty_frac=0.0)
+    # per-row mask form (the serving core's), all-False for row 3
+    mask = np.zeros((64, s), bool)
+    down = np.asarray(base.down_vals).copy()
+    down[3, s - 1] ^= 1  # row 3 diverges in the last slot only
+    base = base._replace(status_mask=mask, down_vals=down)
+    state = jax.tree.map(jax.device_put, base)
+
+    # without a stamp: the divergence reads as spec churn -> UPDATE
+    packed = np.zeros((8, s + 2), np.uint32)
+    step = jax.jit(reconcile_step_packed, static_argnames=("patch_capacity",))
+    state1, wire = step(state, jax.device_put(packed), None, patch_capacity=16)
+    idx, code, upsync, _, _ = unpack_patches(np.asarray(wire))
+    assert idx.tolist() == [3] and code.tolist() == [2] and not upsync[0]
+
+    # with a stamp marking the last slot as status: upsync, not UPDATE
+    stamp = np.zeros((8, s + 2), np.uint32)
+    stamp[0, s - 1] = 1  # mask row: last slot is status
+    stamp[0, s] = 3  # row index
+    stamp[0, s + 1] = 4 | MASK_STAMP_BIT
+    state2, wire = step(state1, jax.device_put(stamp), None, patch_capacity=16)
+    idx, code, upsync, _, _ = unpack_patches(np.asarray(wire))
+    assert idx.tolist() == [3] and code.tolist() == [0] and bool(upsync[0])
+    # the stamp did not corrupt the mirrors (it is not a delta)
+    np.testing.assert_array_equal(np.asarray(state2.down_vals), down)
