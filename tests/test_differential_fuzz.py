@@ -37,12 +37,13 @@ def _cm(name, v, labeled=True):
             "data": {"v": str(v)}}
 
 
-async def _run_backend(backend: str, seed: int):
+async def _run_backend(backend: str, seed: int, mesh=None):
     rng = random.Random(seed)
     kcp, phys = LogicalStore(), LogicalStore()
     up, down = Client(kcp, "t"), Client(phys, "p")
     syncer = await start_syncer(up, down, ["configmaps"], "c1",
-                                backend=backend, resync_period=1.5)
+                                backend=backend, resync_period=1.5,
+                                mesh=mesh)
     for step in range(OPS):
         name = f"cm-{rng.randrange(POOL)}"
         op = rng.random()
@@ -93,6 +94,10 @@ async def _run_backend(backend: str, seed: int):
                 return False
         return True
 
+    if mesh is not None:
+        # positive control: a mesh-plumbing regression would otherwise
+        # make sharded == flat pass vacuously on two unsharded runs
+        assert syncer.engines[0]._section.bucket.mesh is mesh
     deadline = asyncio.get_event_loop().time() + 20
     while not converged():
         if asyncio.get_event_loop().time() > deadline:
@@ -112,5 +117,22 @@ def test_randomized_churn_differential(seed):
         tpu_state = await _run_backend("tpu", seed)
         host_state = await _run_backend("host", seed)
         assert tpu_state == host_state
+
+    asyncio.run(main())
+
+
+def test_randomized_churn_differential_sharded():
+    """The same fuzz over a mesh-sharded serving core: a (4 tenants x 2
+    slots) mesh on the virtual 8-device CPU fleet must converge the
+    random op sequence to the same state as the unsharded tpu backend —
+    random interleavings through the sharded scatter/ack/mask-stamp wire
+    included."""
+    from kcp_tpu.parallel.mesh import make_mesh
+
+    async def main():
+        mesh = make_mesh(n_devices=8, tenants=4, slots=2)
+        sharded = await _run_backend("tpu", 11, mesh=mesh)
+        flat = await _run_backend("tpu", 11)
+        assert sharded == flat
 
     asyncio.run(main())
