@@ -136,3 +136,76 @@ def test_randomized_churn_differential_sharded():
         assert sharded == flat
 
     asyncio.run(main())
+
+
+def test_randomized_two_cluster_migration():
+    """Random label migrations between TWO physical clusters: an object
+    labeled c1 must live in phys1 only, c2 in phys2 only, and random
+    flips migrate it — each syncer sees a filtered DELETE on one side
+    and an ADD on the other (the transparent-multi-cluster mechanic
+    under the deployment splitter). Both syncers share the per-loop
+    fused core, so this also stresses two engines' rows interleaved in
+    one bucket under churn."""
+
+    async def main():
+        rng = random.Random(7)
+        kcp, phys1, phys2 = LogicalStore(), LogicalStore(), LogicalStore()
+        up = Client(kcp, "t")
+        down1, down2 = Client(phys1, "p1"), Client(phys2, "p2")
+        s1 = await start_syncer(up, down1, ["configmaps"], "c1",
+                                resync_period=1.5)
+        s2 = await start_syncer(up, down2, ["configmaps"], "c2",
+                                resync_period=1.5)
+        pool = 12
+        for step in range(90):
+            name = f"cm-{rng.randrange(pool)}"
+            op = rng.random()
+            try:
+                if op < 0.3:
+                    cluster = "c1" if rng.random() < 0.5 else "c2"
+                    o = _cm(name, step, labeled=False)
+                    o["metadata"]["labels"] = {CLUSTER_LABEL: cluster}
+                    up.create("configmaps", o)
+                elif op < 0.55:
+                    o = up.get("configmaps", name, "default")
+                    o["data"] = {"v": str(step)}
+                    up.update("configmaps", o)
+                elif op < 0.7:
+                    up.delete("configmaps", name, "default")
+                else:
+                    # migrate: flip the placement label c1 <-> c2
+                    o = up.get("configmaps", name, "default")
+                    labels = o["metadata"].get("labels") or {}
+                    cur = labels.get(CLUSTER_LABEL)
+                    labels[CLUSTER_LABEL] = "c2" if cur == "c1" else "c1"
+                    o["metadata"]["labels"] = labels
+                    up.update("configmaps", o)
+            except Exception:
+                pass
+            if step % 8 == 0:
+                await asyncio.sleep(0.01)
+
+        def placed():
+            want = {"c1": {}, "c2": {}}
+            for o in up.list("configmaps")[0]:
+                cl = (o["metadata"].get("labels") or {}).get(CLUSTER_LABEL)
+                if cl in want:
+                    want[cl][o["metadata"]["name"]] = o["data"]
+            got1 = {o["metadata"]["name"]: o["data"]
+                    for o in down1.list("configmaps")[0]}
+            got2 = {o["metadata"]["name"]: o["data"]
+                    for o in down2.list("configmaps")[0]}
+            return want["c1"] == got1 and want["c2"] == got2
+
+        try:
+            deadline = asyncio.get_event_loop().time() + 25
+            while not placed():
+                if asyncio.get_event_loop().time() > deadline:
+                    break
+                await asyncio.sleep(0.02)
+            assert placed(), "placement did not converge after migrations"
+        finally:
+            await s1.stop()
+            await s2.stop()
+
+    asyncio.run(main())
