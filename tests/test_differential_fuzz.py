@@ -29,6 +29,16 @@ POOL = 24  # distinct object names
 OPS = 120
 
 
+async def _wait_until(cond, timeout: float) -> bool:
+    """Poll ``cond`` until true or timeout; returns the final value."""
+    deadline = asyncio.get_event_loop().time() + timeout
+    while not cond():
+        if asyncio.get_event_loop().time() > deadline:
+            break
+        await asyncio.sleep(0.02)
+    return cond()
+
+
 def _cm(name, v, labeled=True):
     labels = {CLUSTER_LABEL: "c1"} if labeled else {}
     return {"apiVersion": "v1", "kind": "ConfigMap",
@@ -98,12 +108,8 @@ async def _run_backend(backend: str, seed: int, mesh=None):
         # positive control: a mesh-plumbing regression would otherwise
         # make sharded == flat pass vacuously on two unsharded runs
         assert syncer.engines[0]._section.bucket.mesh is mesh
-    deadline = asyncio.get_event_loop().time() + 20
-    while not converged():
-        if asyncio.get_event_loop().time() > deadline:
-            break
-        await asyncio.sleep(0.02)
-    assert converged(), f"{backend} seed={seed} did not converge"
+    assert await _wait_until(converged, 20), (
+        f"{backend} seed={seed} did not converge")
     state = sorted(
         (o["metadata"]["name"], str(o["data"]), str(o.get("status")))
         for o in down.list("configmaps")[0])
@@ -198,12 +204,8 @@ def test_randomized_two_cluster_migration():
             return want["c1"] == got1 and want["c2"] == got2
 
         try:
-            deadline = asyncio.get_event_loop().time() + 25
-            while not placed():
-                if asyncio.get_event_loop().time() > deadline:
-                    break
-                await asyncio.sleep(0.02)
-            assert placed(), "placement did not converge after migrations"
+            assert await _wait_until(placed, 25), (
+                "placement did not converge after migrations")
         finally:
             await s1.stop()
             await s2.stop()

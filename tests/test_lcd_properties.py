@@ -1,0 +1,174 @@
+"""Property tests for the LCD schema math over random structural schemas.
+
+The reference's table tests (mirrored and extended in
+test_schemacompat.py) pin specific cases; these pin the ALGEBRA the
+negotiation controller depends on — the LCD fold across N cluster
+imports (reference: ensureAPIResourceCompatibility folds imports
+sequentially, pkg/reconciler/apiresource/negotiation.go:338-585) is only
+well-defined if the pairwise LCD behaves like a meet operator:
+
+- idempotent: lcd(a, a) == a with no errors, inputs unmutated
+- absorbing:  lcd(lcd(a, b), a) == lcd(a, b)  and same with b
+  (in narrow mode, where incompatibilities resolve by narrowing)
+- direction-dependent failures are narrowings: compat is deliberately
+  directional (existing=integer, new=number widens and keeps integer;
+  the reverse narrows and errors) — when exactly one direction errors,
+  narrow mode must resolve it
+- deterministic: same inputs, same outputs
+
+Schemas are generated as random structural trees and the second operand
+of each pair is a chain of MUTATIONS of the first (widen/narrow a
+numeric type, grow/shrink an enum or a properties set, toggle string
+bounds) — independently random pairs almost always conflict
+symmetrically and exercise nothing (a previous draft of this file was
+measured ~97% vacuous). Each test counts how often the interesting
+branch actually fired and asserts a floor, so the properties cannot
+silently regress into vacuity again.
+"""
+
+import copy
+import random
+
+from kcp_tpu.schemacompat import ensure_structural_schema_compatibility as ensure
+
+N_SEEDS = 80
+
+
+def _rand_schema(rng: random.Random, depth: int = 0) -> dict:
+    roll = rng.random()
+    if depth >= 2 or roll < 0.25:
+        t = rng.choice(["string", "integer", "number", "boolean"])
+        s: dict = {"type": t}
+        if t == "string" and rng.random() < 0.4:
+            n = rng.randrange(1, 4)
+            s["enum"] = sorted(rng.sample(["a", "b", "c", "d", "e"], n))
+        if t in ("integer", "number") and rng.random() < 0.4:
+            s["minimum"] = rng.randrange(0, 5)
+        if t == "string" and rng.random() < 0.3:
+            s["maxLength"] = rng.randrange(1, 20)
+        return s
+    if roll < 0.45:
+        return {"type": "array", "items": _rand_schema(rng, depth + 1)}
+    s = {"type": "object"}
+    if rng.random() < 0.3:
+        # structural schemas use properties XOR additionalProperties —
+        # emit both forms so the ap comparison branches are reachable
+        s["additionalProperties"] = _rand_schema(rng, depth + 1)
+    else:
+        s["properties"] = {f"f{i}": _rand_schema(rng, depth + 1)
+                           for i in range(rng.randrange(1, 4))}
+    return s
+
+
+def _nodes(schema: dict) -> list[dict]:
+    out = [schema]
+    t = schema.get("type")
+    if t == "object":
+        for v in (schema.get("properties") or {}).values():
+            out.extend(_nodes(v))
+        ap = schema.get("additionalProperties")
+        if isinstance(ap, dict):
+            out.extend(_nodes(ap))
+    elif t == "array":
+        out.extend(_nodes(schema["items"]))
+    return out
+
+
+def _mutate(rng: random.Random, schema: dict) -> dict:
+    """One random widening/narrowing/addition/removal somewhere in a
+    deep copy — related pairs are what make the LCD branches fire."""
+    m = copy.deepcopy(schema)
+    node = rng.choice(_nodes(m))
+    t = node.get("type")
+    roll = rng.random()
+    if t == "integer":
+        node["type"] = "number"  # widen
+    elif t == "number":
+        node["type"] = "integer"  # narrow
+    elif t == "string":
+        if "enum" in node:
+            if roll < 0.5 and len(node["enum"]) > 1:
+                node["enum"] = node["enum"][:-1]  # narrow the enum
+            else:
+                node.pop("enum")  # widen
+        elif roll < 0.4:
+            node["maxLength"] = rng.randrange(1, 10)
+        else:
+            node.pop("maxLength", None)
+    elif t == "object":
+        props = node.get("properties")
+        if props and roll < 0.4 and len(props) > 1:
+            props.pop(sorted(props)[0])  # drop a property
+        elif props is not None:
+            props[f"g{rng.randrange(9)}"] = {"type": "string"}
+        elif roll < 0.5:
+            node["additionalProperties"] = {"type": "string"}
+    elif t == "boolean" and roll < 0.3:
+        node["type"] = "string"  # incompatible type change
+    return m
+
+
+def _pair(seed: int) -> tuple[dict, dict]:
+    rng = random.Random(seed)
+    a = _rand_schema(rng)
+    b = a
+    for _ in range(rng.randrange(1, 4)):
+        b = _mutate(rng, b)
+    return a, b
+
+
+def test_lcd_idempotent():
+    for seed in range(N_SEEDS):
+        rng = random.Random(seed)
+        a = _rand_schema(rng)
+        snapshot = copy.deepcopy(a)
+        lcd, errors = ensure(a, copy.deepcopy(a))
+        assert errors == [], (seed, errors)
+        assert lcd == snapshot, seed
+        assert a == snapshot, seed  # inputs must never be mutated
+
+
+def test_lcd_deterministic_and_directional_errors_narrow():
+    directional = 0
+    for seed in range(N_SEEDS):
+        a, b = _pair(seed)
+        lcd1, err1 = ensure(copy.deepcopy(a), copy.deepcopy(b))
+        lcd2, err2 = ensure(copy.deepcopy(a), copy.deepcopy(b))
+        assert (lcd1, err1) == (lcd2, err2), seed
+        _, err_rev = ensure(copy.deepcopy(b), copy.deepcopy(a))
+        if bool(err1) != bool(err_rev):
+            directional += 1
+            failing = (a, b) if err1 else (b, a)
+            _, err_narrow = ensure(copy.deepcopy(failing[0]),
+                                   copy.deepcopy(failing[1]),
+                                   narrow_existing=True)
+            assert err_narrow == [], (
+                seed, f"one-directional error is not a narrowing: "
+                      f"{err1 or err_rev}")
+    # non-vacuity floor: mutation pairs must actually produce
+    # one-directional widen/narrow cases
+    assert directional >= 10, f"only {directional} directional cases"
+
+
+def test_lcd_absorbing_in_narrow_mode():
+    """Folding an input back into its own LCD must be a no-op — the
+    negotiation controller re-folds every import each reconcile, so a
+    non-absorbing LCD would drift forever."""
+    absorbed = 0
+    for seed in range(N_SEEDS):
+        a, b = _pair(seed)
+        lcd, errors = ensure(copy.deepcopy(a), copy.deepcopy(b),
+                             narrow_existing=True)
+        if errors:
+            continue  # incompatible even narrowed: nothing to absorb
+        absorbed += 1
+        again_a, err_a = ensure(copy.deepcopy(lcd), copy.deepcopy(a),
+                                narrow_existing=True)
+        assert err_a == [], (seed, err_a)
+        assert again_a == lcd, (seed, f"lcd(lcd(a,b), a) != lcd(a,b)")
+        again_b, err_b = ensure(copy.deepcopy(lcd), copy.deepcopy(b),
+                                narrow_existing=True)
+        assert err_b == [], (seed, err_b)
+        assert again_b == lcd, (seed, f"lcd(lcd(a,b), b) != lcd(a,b)")
+    # non-vacuity floor: most mutation chains stay narrow-compatible
+    assert absorbed >= 30, f"only {absorbed} absorbing cases exercised"
