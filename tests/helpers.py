@@ -1,0 +1,28 @@
+"""Shared async test helpers (the canonical copies — new tests should
+import these instead of growing another file-local variant)."""
+
+import asyncio
+
+
+async def wait_until(cond, timeout: float, interval: float = 0.02) -> bool:
+    """Poll ``cond`` until true or timeout; returns the final value."""
+    deadline = asyncio.get_event_loop().time() + timeout
+    while not cond():
+        if asyncio.get_event_loop().time() > deadline:
+            break
+        await asyncio.sleep(interval)
+    return cond()
+
+
+async def eventually(pred, timeout: float = 8.0, interval: float = 0.01):
+    """Poll ``pred`` (exceptions = not yet) until true, or raise."""
+    deadline = asyncio.get_event_loop().time() + timeout
+    while True:
+        try:
+            if pred():
+                return
+        except Exception:
+            pass
+        if asyncio.get_event_loop().time() > deadline:
+            raise AssertionError("condition not reached")
+        await asyncio.sleep(interval)

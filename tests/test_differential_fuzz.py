@@ -25,18 +25,10 @@ from kcp_tpu.store import LogicalStore
 from kcp_tpu.syncer import start_syncer
 from kcp_tpu.syncer.engine import CLUSTER_LABEL
 
+from helpers import wait_until as _wait_until
+
 POOL = 24  # distinct object names
 OPS = 120
-
-
-async def _wait_until(cond, timeout: float) -> bool:
-    """Poll ``cond`` until true or timeout; returns the final value."""
-    deadline = asyncio.get_event_loop().time() + timeout
-    while not cond():
-        if asyncio.get_event_loop().time() > deadline:
-            break
-        await asyncio.sleep(0.02)
-    return cond()
 
 
 def _cm(name, v, labeled=True):
