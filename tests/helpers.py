@@ -1,5 +1,9 @@
-"""Shared async test helpers (the canonical copies — new tests should
-import these instead of growing another file-local variant)."""
+"""Shared async test helpers.
+
+(Several older files carry their own `eventually` variants with
+file-specific defaults and diagnostics; consolidating them would change
+per-file timeout behavior for no coverage gain, so only genuinely
+shared helpers live here.)"""
 
 import asyncio
 
@@ -12,17 +16,3 @@ async def wait_until(cond, timeout: float, interval: float = 0.02) -> bool:
             break
         await asyncio.sleep(interval)
     return cond()
-
-
-async def eventually(pred, timeout: float = 8.0, interval: float = 0.01):
-    """Poll ``pred`` (exceptions = not yet) until true, or raise."""
-    deadline = asyncio.get_event_loop().time() + timeout
-    while True:
-        try:
-            if pred():
-                return
-        except Exception:
-            pass
-        if asyncio.get_event_loop().time() > deadline:
-            raise AssertionError("condition not reached")
-        await asyncio.sleep(interval)

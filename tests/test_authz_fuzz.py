@@ -1,0 +1,121 @@
+"""RBAC escalation property fuzz: no sequence of ADMITTED writes can
+grow the fleet's permission union.
+
+The escalation check (server/authz.py, Kubernetes' RBAC escalation
+prevention) admits a clusterrole/clusterrolebinding write only when the
+writer already holds what the write grants (or the escalate/bind verbs).
+The security property that should FOLLOW from per-write checks is
+global: starting from admin's initial grants, random sequences of
+admitted non-admin writes may SPREAD permissions between users (granting
+what you hold is delegation) but must never mint a permission triple
+nobody held — and a user's own admitted write must never enlarge that
+user's own effective set. Both are checked over a concrete probe matrix
+after every admitted write.
+"""
+
+import itertools
+import random
+
+from kcp_tpu.server.authz import BINDINGS, CLUSTERROLES, Authorizer
+from kcp_tpu.store import LogicalStore
+
+CLUSTER = "team-a"
+USERS = ["u1", "u2", "u3"]
+VERBS = ["get", "list", "create", "update", "delete", "escalate", "bind", "*"]
+GROUPS = ["", "rbac.authorization.k8s.io", "apps"]
+RESOURCES = ["configmaps", "clusterroles", "clusterrolebindings",
+             "deployments", "widgets"]
+PROBES = [(v, g, r) for v in VERBS for g in GROUPS for r in RESOURCES]
+RBAC_GROUP = "rbac.authorization.k8s.io"
+
+
+def _effective(authz: Authorizer, user: str) -> frozenset:
+    return frozenset(p for p in PROBES
+                     if authz.allowed(user, CLUSTER, *p))
+
+
+def _rand_rules(rng: random.Random) -> list[dict]:
+    rules = []
+    for _ in range(rng.randrange(1, 3)):
+        rules.append({
+            "verbs": rng.sample(VERBS, rng.randrange(1, 3)),
+            "apiGroups": rng.sample(GROUPS, rng.randrange(1, 2)),
+            "resources": rng.sample(RESOURCES, rng.randrange(1, 3)),
+        })
+    return rules
+
+
+def _admit(authz: Authorizer, user: str, resource_short: str,
+           body: dict) -> bool:
+    """Mirror the REST handler's gate: verb RBAC + escalation check."""
+    full = CLUSTERROLES if resource_short == "clusterroles" else BINDINGS
+    if not authz.allowed(user, CLUSTER, "create", RBAC_GROUP,
+                         full.split(".")[0]):
+        return False
+    return authz.escalation_denied(user, CLUSTER, resource_short,
+                                   body) is None
+
+
+def test_admitted_writes_never_mint_permissions():
+    for seed in range(6):
+        rng = random.Random(seed)
+        store = LogicalStore()
+        authz = Authorizer(store)
+        # admin bootstrap: random roles, randomly bound to users — always
+        # including write access to rbac objects for at least one user so
+        # the fuzz has an interesting actor
+        names = itertools.count()
+        for i in range(rng.randrange(2, 5)):
+            role = f"boot-{i}"
+            rules = _rand_rules(rng)
+            if i == 0:
+                rules.append({"verbs": ["create", "update"],
+                              "apiGroups": [RBAC_GROUP],
+                              "resources": ["clusterroles",
+                                            "clusterrolebindings"]})
+            store.create(CLUSTERROLES, CLUSTER,
+                         {"metadata": {"name": role}, "rules": rules})
+            for u in rng.sample(USERS, rng.randrange(1, len(USERS) + 1)):
+                store.create(BINDINGS, CLUSTER, {
+                    "metadata": {"name": f"bind-{next(names)}"},
+                    "subjects": [{"kind": "User", "name": u}],
+                    "roleRef": {"name": role},
+                })
+
+        union0 = frozenset().union(*(_effective(authz, u) for u in USERS))
+        admitted = 0
+        for step in range(40):
+            user = rng.choice(USERS)
+            before_self = _effective(authz, user)
+            if rng.random() < 0.5:
+                body = {"metadata": {"name": f"r-{next(names)}"},
+                        "rules": _rand_rules(rng)}
+                ok = _admit(authz, user, "clusterroles", body)
+                if ok:
+                    store.create(CLUSTERROLES, CLUSTER, body)
+            else:
+                target_role = rng.choice(
+                    [o["metadata"]["name"]
+                     for o in store.list(CLUSTERROLES, CLUSTER)[0]]
+                    + ["cluster-admin", "ghost-role"])
+                body = {"metadata": {"name": f"b-{next(names)}"},
+                        "subjects": [{"kind": "User",
+                                      "name": rng.choice(USERS)}],
+                        "roleRef": {"name": target_role}}
+                ok = _admit(authz, user, "clusterrolebindings", body)
+                if ok:
+                    store.create(BINDINGS, CLUSTER, body)
+            if not ok:
+                continue
+            admitted += 1
+            # 1. the writer's own set never grows from their own write
+            after_self = _effective(authz, user)
+            assert after_self - before_self == frozenset(), (
+                seed, step, user, sorted(after_self - before_self))
+            # 2. the fleet union never exceeds the bootstrap union
+            union = frozenset().union(
+                *(_effective(authz, u) for u in USERS))
+            assert union <= union0, (
+                seed, step, user, sorted(union - union0))
+        # the fuzz must actually admit writes to mean anything
+        assert admitted >= 3, f"seed {seed}: only {admitted} admitted writes"

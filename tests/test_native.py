@@ -362,9 +362,12 @@ class TestCrashPointFuzz:
         rng = random.Random(5)
         p = str(tmp_path / "s.wal")
         w = WalEngine(p, sync_every=1)
-        # a committed op log with puts, overwrites, and deletes
+        # a committed op log with puts, overwrites, and deletes;
+        # boundaries[i] = file size after op i (sync_every=1 flushes
+        # per op), used to pin the exact healed size per cut
         live: dict[bytes, bytes] = {}
         states = []  # state snapshot AFTER each op
+        boundaries = [8]  # the magic header alone
         for rv in range(1, 41):
             key = f"k{rng.randrange(12)}".encode()
             if key in live and rng.random() < 0.25:
@@ -375,6 +378,7 @@ class TestCrashPointFuzz:
                 w.put(key, val, rv)
                 live[key] = val
             states.append(dict(live))
+            boundaries.append(os.path.getsize(p))
         w.close()
         size = os.path.getsize(p)
         blob = open(p, "rb").read()
@@ -388,10 +392,13 @@ class TestCrashPointFuzz:
             w2.close()
             assert got in valid_states, (
                 f"cut at {cut}: state {got} is not a prefix of the op log")
-            # self-heal: the torn tail is truncated back to the last good
-            # record (a fresh/short file is rewritten to the 8B header)
-            assert os.path.getsize(p) <= max(cut, 8), (
-                f"cut at {cut}: garbage tail left in place")
+            # self-heal: the file is truncated back to EXACTLY the last
+            # complete record boundary (a fresh/short file is rewritten
+            # to the 8B header) — a partial record must never remain
+            want = max(b for b in boundaries if b <= max(cut, 8))
+            assert os.path.getsize(p) == want, (
+                f"cut at {cut}: healed to {os.path.getsize(p)}, "
+                f"expected boundary {want}")
         # the final intact file replays fully
         with open(p, "wb") as f:
             f.write(blob)
