@@ -43,6 +43,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import time
 from typing import Callable, Protocol, Sequence
 
@@ -62,6 +63,14 @@ from ..reconciler.controller import BatchController
 from ..utils.trace import REGISTRY
 
 log = logging.getLogger(__name__)
+
+
+def _grown(a: np.ndarray, shape, dtype) -> np.ndarray:
+    """Zero-padded copy of ``a`` at a larger ``shape`` (growth helper for
+    the mirror and staging buffers)."""
+    out = np.zeros(shape, dtype)
+    out[: a.shape[0], ...] = a
+    return out
 
 
 def _phase(name: str, dt: float) -> None:
@@ -172,8 +181,6 @@ class FusedBucket:
         self.use_pallas = use_pallas
         # converged-row ack compression kill switch, resolved once (the
         # opt-out cannot change mid-process; staging is the hot path)
-        import os
-
         self.use_acks = os.environ.get("KCP_NO_ACKS") != "1"
         # sharded state must device_put cleanly: row counts are padded to
         # a multiple of the row-axis product (see _grow), and the slots
@@ -278,16 +285,11 @@ class FusedBucket:
             # axis): round up so every row dimension device_puts cleanly
             new_b += self._row_factor - new_b % self._row_factor
 
-        def grow(a, shape, dtype):
-            out = np.zeros(shape, dtype)
-            out[: a.shape[0], ...] = a
-            return out
-
-        self.up_vals = grow(self.up_vals, (new_b, self.S), np.uint32)
-        self.down_vals = grow(self.down_vals, (new_b, self.S), np.uint32)
-        self.up_exists = grow(self.up_exists, (new_b,), bool)
-        self.down_exists = grow(self.down_exists, (new_b,), bool)
-        self.status_mask = grow(self.status_mask, (new_b, self.S), bool)
+        self.up_vals = _grown(self.up_vals, (new_b, self.S), np.uint32)
+        self.down_vals = _grown(self.down_vals, (new_b, self.S), np.uint32)
+        self.up_exists = _grown(self.up_exists, (new_b,), bool)
+        self.down_exists = _grown(self.down_exists, (new_b,), bool)
+        self.status_mask = _grown(self.status_mask, (new_b, self.S), bool)
         slot = np.full(2 * new_b, -1, np.int32)
         slot[: self._staged_slot.shape[0]] = self._staged_slot
         self._staged_slot = slot
@@ -390,17 +392,11 @@ class FusedBucket:
         if need <= cap:
             return
         new_cap = pad_pow2(max(need, MIN_EVENTS))
-
-        def grow(a, shape, dtype):
-            out = np.zeros(shape, dtype)
-            out[: a.shape[0], ...] = a
-            return out
-
-        self._staged_vals = grow(self._staged_vals, (new_cap, self.S), np.uint32)
-        self._staged_rows = grow(self._staged_rows, (new_cap,), np.uint32)
-        self._staged_flags = grow(self._staged_flags, (new_cap,), np.uint32)
-        self._staged_keys = grow(self._staged_keys, (new_cap,), np.int64)
-        self._staged_ack = grow(self._staged_ack, (new_cap,), bool)
+        self._staged_vals = _grown(self._staged_vals, (new_cap, self.S), np.uint32)
+        self._staged_rows = _grown(self._staged_rows, (new_cap,), np.uint32)
+        self._staged_flags = _grown(self._staged_flags, (new_cap,), np.uint32)
+        self._staged_keys = _grown(self._staged_keys, (new_cap,), np.int64)
+        self._staged_ack = _grown(self._staged_ack, (new_cap,), bool)
 
     def _clear_staged(self) -> None:
         n = self._staged_n
@@ -416,28 +412,10 @@ class FusedBucket:
 
     def stage(self, row: int, side: bool, vals: np.ndarray, exists: bool) -> None:
         """Stage one delta event (last-wins per (row, side)) and mirror it
-        into host staging (the rebuild source of truth)."""
-        if side:
-            self.down_vals[row, : vals.shape[0]] = vals
-            self.down_vals[row, vals.shape[0]:] = 0
-            self.down_exists[row] = exists
-        else:
-            self.up_vals[row, : vals.shape[0]] = vals
-            self.up_vals[row, vals.shape[0]:] = 0
-            self.up_exists[row] = exists
-        key = (row << 1) | side
-        slot = self._staged_slot[key]
-        if slot < 0:
-            slot = self._staged_n
-            self._ensure_staged_capacity(slot + 1)
-            self._staged_slot[key] = slot
-            self._staged_keys[slot] = key
-            self._staged_rows[slot] = row
-            self._staged_n += 1
-        self._staged_vals[slot, : vals.shape[0]] = vals
-        self._staged_vals[slot, vals.shape[0]:] = 0
-        self._staged_flags[slot] = (1 if exists else 0) | (2 if side else 0) | 4
-        self._staged_ack[slot] = False
+        into host staging (the rebuild source of truth). The 1-row form
+        of :meth:`stage_many` — one copy of the slot-map logic."""
+        self.stage_many(np.array([row]), side, np.asarray(vals)[None, :],
+                        np.array([exists]))
 
     def stage_many(self, rows: np.ndarray, side: bool, vals: np.ndarray,
                    exists: np.ndarray) -> None:
@@ -666,8 +644,6 @@ class FusedCore:
                  use_pallas: bool | None = None):
         self.mesh = mesh
         if use_pallas is None:
-            import os
-
             use_pallas = os.environ.get("KCP_PALLAS", "") == "1"
         self.use_pallas = use_pallas
         self.buckets: dict[int, FusedBucket] = {}

@@ -71,8 +71,15 @@ def test_resume_delivers_exactly_the_missed_suffix(seed):
         assert got2 == committed[-n_more:], (seed, round_)
         w.close()
 
-    # resuming below the retained window must raise, never silently skip
-    oldest = store._history[0].rv
-    if oldest > 1:
-        with pytest.raises(ConflictError):
-            store.watch("configmaps", "t", since_rv=0)
+    # resuming below the retained window must raise, never silently skip.
+    # The store's default retention (200k events) never evicts at this
+    # scale, so shrink the window and push events past it to make the
+    # expired branch genuinely reachable.
+    from collections import deque
+
+    store._history = deque(store._history, maxlen=16)
+    for _ in range(32):
+        mutate()
+    assert store._history[0].rv > 1  # the window actually moved
+    with pytest.raises(ConflictError):
+        store.watch("configmaps", "t", since_rv=0)
