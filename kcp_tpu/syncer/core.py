@@ -143,8 +143,11 @@ class Section:
             # the DEVICE must see this stamp too: the delta wire carries
             # values only, and without a mask stamp a row allocated after
             # the last full upload reads its status churn as spec churn
-            # forever (fuzz-found) — ship it as a wire entry
-            if self._mask.any():
+            # forever (fuzz-found) — ship it as a wire entry. A stale
+            # bucket needs no stamp: the pending full upload carries the
+            # host mask arrays wholesale (and bulk row preallocation
+            # before the first tick would otherwise stage one per row)
+            if self._mask.any() and not self.bucket._stale:
                 self.bucket.stage_mask(row, self.bucket.status_mask[row])
         return row
 
@@ -355,11 +358,8 @@ class FusedBucket:
         new_r = pad_pow2(max(needed, 8))
         if new_r % self._row_factor:
             new_r += self._row_factor - new_r % self._row_factor
-        reps = np.zeros(new_r, np.int32)
-        reps[: self.R] = self.pl_replicas
-        avail = np.zeros((new_r, self.P), bool)
-        avail[: self.R] = self.pl_avail
-        self.pl_replicas, self.pl_avail = reps, avail
+        self.pl_replicas = _grown(self.pl_replicas, (new_r,), np.int32)
+        self.pl_avail = _grown(self.pl_avail, (new_r, self.P), bool)
         self.R = new_r
         # shape change: the resident current[R,P] must be rebuilt too
         self.mark_stale()
