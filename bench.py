@@ -444,7 +444,7 @@ def suite() -> int:
 
     from kcp_tpu.ops.labelmatch import fanout_match
     from kcp_tpu.ops.placement import split_replicas_jit
-    from kcp_tpu.ops.schemahash import schema_hashes_jit, tokenize_schema
+    from kcp_tpu.ops.schemahash import schema_hashes_jit, tokenize_schemas
 
     best: dict = {}
     deadman = Deadman(best)
@@ -481,7 +481,7 @@ def suite() -> int:
         for k in range(n_schemas)
     ]
     t0 = time.perf_counter()
-    tokens = np.stack([tokenize_schema(s) for s in schemas])
+    tokens = tokenize_schemas(schemas)
     host_dt = time.perf_counter() - t0
     toks = jax.device_put(tokens)
     dev_dt = _time_kernel(schema_hashes_jit, toks)

@@ -650,7 +650,9 @@ class FusedCore:
         self.controller = BatchController(
             "fused-core", self._process_batch, batch_window=batch_window
         )
-        self._inflight: list[tuple[FusedBucket, jax.Array]] = []
+        self._inflight: list[
+            tuple[FusedBucket, jax.Array, tuple[int, int]]
+        ] = []
         self._flush_task: asyncio.Task | None = None
         self._eager_collect: bool | None = None  # resolved on first flush
         self._refs = 0
@@ -913,8 +915,14 @@ class FusedCore:
                 await asyncio.sleep(IDLE_FLUSH_S)
             while self._inflight:
                 bucket, wire, meta = self._inflight[0]
+                # exponential poll backoff: a tunnel-attached device has
+                # ~tens-of-ms round trips, so a flat 1 ms poll would wake
+                # the loop ~100x per wire for no data; cap at 8 ms so a
+                # ready wire is still collected promptly
+                poll = 0.001
                 while not wire.is_ready():
-                    await asyncio.sleep(0.001)
+                    await asyncio.sleep(poll)
+                    poll = min(poll * 2, 0.008)
                 # the head can change across the awaits (a tick's depth-
                 # based collect pops it, and a collect failure means
                 # _schedule_flush never cancelled this task) — pop only

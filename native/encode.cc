@@ -149,3 +149,70 @@ uint32_t enc_hash_pair(const uint8_t* key, size_t klen, const uint8_t* value, si
 }
 
 }  // extern "C"
+
+// ----------------------------------------------------------- schema tokenizer
+
+namespace {
+
+// Structural markers — must equal kcp_tpu/ops/schemahash.tokenize_schema's
+// OPEN/CLOSE/LIST_OPEN/LIST_CLOSE so native and Python token streams are
+// byte-for-byte interchangeable (the differential test feeds both the
+// same corpus).
+constexpr uint32_t TOK_OPEN = 0xA11CE;
+constexpr uint32_t TOK_CLOSE = 0xB0B;
+constexpr uint32_t TOK_LIST_OPEN = 0xC0DE;
+constexpr uint32_t TOK_LIST_CLOSE = 0xD00D;
+
+// Exact twin of the Python walk, including its truncation semantics:
+// the size check happens only at walk entry, so a wide dict still
+// appends every key hash and the trailing CLOSE past max_tokens — the
+// final copy truncates, and the appended length token disambiguates.
+void tok_walk(const JValue& v, uint32_t max_tokens, std::vector<uint32_t>* toks) {
+  if (toks->size() >= max_tokens) return;
+  switch (v.type) {
+    case JValue::Obj: {
+      toks->push_back(TOK_OPEN);
+      for (const auto* e : sorted_entries(v)) {
+        toks->push_back(
+            fnv1a(reinterpret_cast<const uint8_t*>(e->first.data()), e->first.size()));
+        tok_walk(e->second, max_tokens, toks);
+      }
+      toks->push_back(TOK_CLOSE);
+      break;
+    }
+    case JValue::Arr: {
+      toks->push_back(TOK_LIST_OPEN);
+      for (const auto& item : v.arr) tok_walk(item, max_tokens, toks);
+      toks->push_back(TOK_LIST_CLOSE);
+      break;
+    }
+    default:
+      toks->push_back(hash_jvalue(v));
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+int enc_tokenize_schemas(const char* data, const uint64_t* offsets, uint32_t n,
+                         uint32_t max_tokens, uint32_t* out) {
+  std::vector<uint32_t> toks;
+  for (uint32_t i = 0; i < n; i++) {
+    const char* s = data + offsets[i];
+    size_t len = size_t(offsets[i + 1] - offsets[i]);
+    JValue root;
+    std::string err;
+    if (!kcpnative::json_parse(s, len, &root, &err)) return -int(i) - 1;
+    toks.clear();
+    tok_walk(root, max_tokens, &toks);
+    toks.push_back(uint32_t(toks.size()));  // length token guards truncation collisions
+    uint32_t* row = out + size_t(i) * max_tokens;
+    uint32_t m = uint32_t(toks.size()) < max_tokens ? uint32_t(toks.size()) : max_tokens;
+    for (uint32_t j = 0; j < m; j++) row[j] = toks[j];
+    for (uint32_t j = m; j < max_tokens; j++) row[j] = 0;
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -115,6 +115,14 @@ uint32_t enc_fnv1a(const uint8_t* data, size_t len, uint32_t seed);
 // Label pair hash: fnv1a(key + "\0" + value), 0 mapped to 1.
 uint32_t enc_hash_pair(const uint8_t* key, size_t klen, const uint8_t* value, size_t vlen);
 
+// Batch schema tokenizer (twin of kcp_tpu/ops/schemahash.tokenize_schema).
+// data holds n concatenated canonical-JSON schemas; schema i spans
+// [offsets[i], offsets[i+1]). Writes n rows of max_tokens uint32 tokens
+// (zero-padded) into out. Returns 0 on success, -(i+1) if schema i
+// failed to parse (out rows before i are valid).
+int enc_tokenize_schemas(const char* data, const uint64_t* offsets, uint32_t n,
+                         uint32_t max_tokens, uint32_t* out);
+
 #ifdef __cplusplus
 }
 #endif

@@ -1,0 +1,249 @@
+// kcptok — CPython extension: schema tokenizer that walks Python dicts
+// DIRECTLY (no json.dumps, no re-parse). Twin of
+// kcp_tpu/ops/schemahash.tokenize_schema_py; the batch JSON-blob path
+// (encode.cc enc_tokenize_schemas) remains as the mid fallback and the
+// Python walk as the reference implementation.
+//
+// Why this exists: BASELINE configs[3] re-buckets 5k tenant CRD sets per
+// negotiation pass. The Python walk costs ~35-50 us/schema and even the
+// serialize-then-native path pays ~11 us of json.dumps per schema; this
+// walk touches each PyObject once and feeds bytes straight into FNV,
+// with zero allocation per scalar. Anything non-JSON-shaped (tuples,
+// custom types, non-str keys) returns a "unsupported" rc and the caller
+// falls back — the extension never guesses.
+//
+// Hash semantics are locked to kcp_tpu/ops/hashing.py:
+//   key tokens   = fnv1a(utf8(key))                        (no 0->1 map)
+//   leaf tokens  = fnv1a(json.dumps-rendered scalar), 0->1
+// and the structural markers + truncation semantics are locked to
+// tokenize_schema_py (size check at walk entry only; trailing length
+// token; row truncated to max_tokens, zero-padded).
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <algorithm>
+#include <cmath>
+#include <cstring>
+#include <vector>
+
+#include "common.h"
+
+namespace {
+
+constexpr uint32_t TOK_OPEN = 0xA11CE;
+constexpr uint32_t TOK_CLOSE = 0xB0B;
+constexpr uint32_t TOK_LIST_OPEN = 0xC0DE;
+constexpr uint32_t TOK_LIST_CLOSE = 0xD00D;
+
+// Streaming FNV-1a so scalar rendering never allocates.
+struct Fnv {
+  uint32_t h = kcpnative::FNV_OFFSET;
+  inline void byte(unsigned char b) {
+    h ^= b;
+    h *= kcpnative::FNV_PRIME;
+  }
+  inline void feed(const char* d, size_t n) {
+    for (size_t i = 0; i < n; i++) byte((unsigned char)d[i]);
+  }
+};
+
+// Feed a UTF-8 string rendered exactly as json.dumps(ensure_ascii=False)
+// would: quoted, with ", \, \b \f \n \r \t short-escaped and remaining
+// control bytes as \u00xx (jsoncanon.cc write_escaped is the same table).
+void feed_escaped(Fnv* f, const char* s, Py_ssize_t n) {
+  f->byte('"');
+  for (Py_ssize_t i = 0; i < n; i++) {
+    unsigned char c = (unsigned char)s[i];
+    switch (c) {
+      case '"': f->feed("\\\"", 2); break;
+      case '\\': f->feed("\\\\", 2); break;
+      case '\b': f->feed("\\b", 2); break;
+      case '\f': f->feed("\\f", 2); break;
+      case '\n': f->feed("\\n", 2); break;
+      case '\r': f->feed("\\r", 2); break;
+      case '\t': f->feed("\\t", 2); break;
+      default:
+        if (c < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof(buf), "\\u%04x", c);
+          f->feed(buf, 6);
+        } else {
+          f->byte(c);
+        }
+    }
+  }
+  f->byte('"');
+}
+
+// Hash one JSON scalar as canonical_json renders it. Returns false on a
+// non-JSON-scalar type (caller falls back to the Python walk) or on a
+// Python-level error (error indicator set).
+bool scalar_hash(PyObject* v, uint32_t* out) {
+  Fnv f;
+  if (v == Py_None) {
+    f.feed("null", 4);
+  } else if (PyBool_Check(v)) {  // before PyLong_Check: bool is an int
+    if (v == Py_True)
+      f.feed("true", 4);
+    else
+      f.feed("false", 5);
+  } else if (PyLong_Check(v)) {
+    int overflow = 0;
+    long long x = PyLong_AsLongLongAndOverflow(v, &overflow);
+    if (x == -1 && PyErr_Occurred()) return false;
+    if (!overflow) {
+      char buf[32];
+      int n = snprintf(buf, sizeof(buf), "%lld", x);
+      f.feed(buf, (size_t)n);
+    } else {
+      // arbitrary-precision tail: render via str() like json.dumps does
+      PyObject* s = PyObject_Str(v);
+      if (!s) return false;
+      Py_ssize_t n;
+      const char* u = PyUnicode_AsUTF8AndSize(s, &n);
+      if (!u) {
+        Py_DECREF(s);
+        return false;
+      }
+      f.feed(u, (size_t)n);
+      Py_DECREF(s);
+    }
+  } else if (PyFloat_Check(v)) {
+    double d = PyFloat_AS_DOUBLE(v);
+    if (std::isnan(d)) {
+      f.feed("NaN", 3);
+    } else if (std::isinf(d)) {
+      if (d > 0)
+        f.feed("Infinity", 8);
+      else
+        f.feed("-Infinity", 9);
+    } else {
+      // float.__repr__'s shortest-repr rendering — the exact bytes
+      // json.dumps emits for a finite float
+      char* buf = PyOS_double_to_string(d, 'r', 0, Py_DTSF_ADD_DOT_0, nullptr);
+      if (!buf) return false;
+      f.feed(buf, strlen(buf));
+      PyMem_Free(buf);
+    }
+  } else if (PyUnicode_Check(v)) {
+    Py_ssize_t n;
+    const char* u = PyUnicode_AsUTF8AndSize(v, &n);
+    if (!u) return false;
+    feed_escaped(&f, u, n);
+  } else {
+    return false;  // tuple / custom type: not JSON-shaped, fall back
+  }
+  *out = f.h ? f.h : 1;
+  return true;
+}
+
+struct KeyRef {
+  const char* bytes;
+  Py_ssize_t len;
+  PyObject* value;  // borrowed
+};
+
+// UTF-8 byte order == code-point order == Python's sorted() on str.
+inline bool key_less(const KeyRef& a, const KeyRef& b) {
+  int c = memcmp(a.bytes, b.bytes, (size_t)std::min(a.len, b.len));
+  if (c != 0) return c < 0;
+  return a.len < b.len;
+}
+
+// Exact twin of the Python walk (truncation check at entry only).
+// Returns false on unsupported type / Python error. Depth-bounded well
+// under the C stack limit; the Python fallback covers deeper nests (it
+// is itself bounded by the interpreter recursion limit).
+bool walk(PyObject* v, uint32_t max_tokens, int depth, std::vector<uint32_t>* toks) {
+  if (depth > 512) return false;
+  if (toks->size() >= max_tokens) return true;
+  if (PyDict_Check(v)) {
+    toks->push_back(TOK_OPEN);
+    std::vector<KeyRef> keys;
+    keys.reserve((size_t)PyDict_Size(v));
+    PyObject *key, *val;
+    Py_ssize_t pos = 0;
+    while (PyDict_Next(v, &pos, &key, &val)) {
+      if (!PyUnicode_Check(key)) return false;  // non-str key: not JSON
+      Py_ssize_t kn;
+      const char* ku = PyUnicode_AsUTF8AndSize(key, &kn);
+      if (!ku) return false;
+      keys.push_back({ku, kn, val});
+    }
+    std::sort(keys.begin(), keys.end(), key_less);
+    for (const KeyRef& k : keys) {
+      toks->push_back(kcpnative::fnv1a((const uint8_t*)k.bytes, (size_t)k.len));
+      if (!walk(k.value, max_tokens, depth + 1, toks)) return false;
+    }
+    toks->push_back(TOK_CLOSE);
+    return true;
+  }
+  if (PyList_Check(v)) {
+    toks->push_back(TOK_LIST_OPEN);
+    for (Py_ssize_t i = 0; i < PyList_GET_SIZE(v); i++) {
+      if (!walk(PyList_GET_ITEM(v, i), max_tokens, depth + 1, toks)) return false;
+    }
+    toks->push_back(TOK_LIST_CLOSE);
+    return true;
+  }
+  uint32_t h;
+  if (!scalar_hash(v, &h)) return false;
+  toks->push_back(h);
+  return true;
+}
+
+// tokenize(schemas: list, max_tokens: int, out: writable buffer) -> int
+//   0  on success (out filled with len(schemas) rows of max_tokens u32)
+//  -(i+1) if schema i is not JSON-shaped (caller falls back; no Python
+//         error is left set). Raises only on misuse (wrong arg types /
+//         undersized buffer).
+PyObject* tokenize(PyObject* /*self*/, PyObject* args) {
+  PyObject* seq;
+  unsigned int max_tokens;
+  Py_buffer buf;
+  if (!PyArg_ParseTuple(args, "O!Iw*", &PyList_Type, &seq, &max_tokens, &buf)) return nullptr;
+  Py_ssize_t n = PyList_GET_SIZE(seq);
+  if (!PyBuffer_IsContiguous(&buf, 'C') ||
+      buf.len < (Py_ssize_t)((size_t)n * max_tokens * sizeof(uint32_t))) {
+    PyBuffer_Release(&buf);
+    PyErr_SetString(PyExc_ValueError, "output buffer too small or not contiguous");
+    return nullptr;
+  }
+  auto* out = (uint32_t*)buf.buf;
+  std::vector<uint32_t> toks;
+  long rc = 0;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    toks.clear();
+    if (!walk(PyList_GET_ITEM(seq, i), max_tokens, 0, &toks)) {
+      if (PyErr_Occurred()) {
+        PyBuffer_Release(&buf);
+        return nullptr;
+      }
+      rc = -(long)(i + 1);
+      break;
+    }
+    toks.push_back((uint32_t)toks.size());  // length token
+    uint32_t* row = out + (size_t)i * max_tokens;
+    uint32_t m = toks.size() < max_tokens ? (uint32_t)toks.size() : max_tokens;
+    memcpy(row, toks.data(), (size_t)m * sizeof(uint32_t));
+    memset(row + m, 0, (size_t)(max_tokens - m) * sizeof(uint32_t));
+  }
+  PyBuffer_Release(&buf);
+  return PyLong_FromLong(rc);
+}
+
+PyMethodDef methods[] = {
+    {"tokenize", tokenize, METH_VARARGS,
+     "tokenize(schemas, max_tokens, out_buffer) -> 0 | -(i+1)"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "kcptok",
+    "Direct-walk schema tokenizer (twin of kcp_tpu.ops.schemahash).",
+    -1, methods, nullptr, nullptr, nullptr, nullptr,
+};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit_kcptok(void) { return PyModule_Create(&moduledef); }

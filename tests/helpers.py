@@ -10,9 +10,10 @@ import asyncio
 
 async def wait_until(cond, timeout: float, interval: float = 0.02) -> bool:
     """Poll ``cond`` until true or timeout; returns the final value."""
-    deadline = asyncio.get_event_loop().time() + timeout
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
     while not cond():
-        if asyncio.get_event_loop().time() > deadline:
+        if loop.time() > deadline:
             break
         await asyncio.sleep(interval)
     return cond()

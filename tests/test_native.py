@@ -73,6 +73,82 @@ class TestHashParity:
             )
 
 
+class TestTokenizerParity:
+    """native enc_tokenize_schemas vs the Python walk (schemahash)."""
+
+    def test_extension_loads(self):
+        # hard requirement in this image (Python dev headers present):
+        # without it the dispatcher-based parity tests below would
+        # compare the Python walk against itself and pass vacuously
+        from kcp_tpu.native import load_tokenizer
+
+        assert load_tokenizer() is not None
+
+    def test_fuzz_corpus(self):
+        from kcp_tpu.native import tokenize_schemas_native
+        from kcp_tpu.ops.hashing import canonical_json
+        from kcp_tpu.ops.schemahash import tokenize_schema_py, tokenize_schemas
+
+        rng = random.Random(11)
+        # dict roots (the real input shape) plus arbitrary roots — the
+        # walk accepts any JSON value at top level
+        schemas = [_rand_value(rng) for _ in range(300)]
+        want = np.stack([tokenize_schema_py(s) for s in schemas])
+        # tier 1 (direct dict walk, via the dispatcher)
+        np.testing.assert_array_equal(tokenize_schemas(schemas), want)
+        # tier 2 (serialize + native JSON parse/walk), exercised directly
+        blobs = [canonical_json(s).encode() for s in schemas]
+        np.testing.assert_array_equal(tokenize_schemas_native(blobs, 256), want)
+
+    def test_non_json_shapes_fall_back(self):
+        from kcp_tpu.ops.schemahash import tokenize_schema_py, tokenize_schemas
+
+        # tuples and non-str keys are not JSON-shaped: the native tiers
+        # must refuse them (rather than guess) and the dispatcher must
+        # land on the Python walk, which treats a tuple as an opaque
+        # subtree leaf
+        s = {"a": (1, 2), "b": "x"}
+        np.testing.assert_array_equal(
+            tokenize_schemas([s])[0], tokenize_schema_py(s)
+        )
+
+    def test_truncation_boundaries(self):
+        from kcp_tpu.ops.schemahash import tokenize_schema_py, tokenize_schemas
+
+        # wide dict: key hashes keep appending past max_tokens (the
+        # Python walk only checks size at entry); deep list nesting hits
+        # the entry check exactly; each must truncate identically
+        wide = {f"k{i:04d}": i for i in range(400)}
+        deep: object = 1
+        for _ in range(120):
+            deep = [deep]
+        exact = {"p": {f"f{i}": "x" for i in range(126)}}
+        for mt in (8, 64, 256):
+            got = tokenize_schemas([wide, deep, exact], max_tokens=mt)
+            want = np.stack(
+                [tokenize_schema_py(s, max_tokens=mt) for s in (wide, deep, exact)]
+            )
+            np.testing.assert_array_equal(got, want)
+
+    def test_unicode_and_escapes(self):
+        from kcp_tpu.ops.schemahash import tokenize_schema_py, tokenize_schemas
+
+        s = {
+            "desc\n": 'quote " backslash \\ tab\t',
+            "中文": ["λ", "\x01control", "sur\U0001f600rogate"],
+            "num": [0.0, -0.0, 1e3, -1.5e-7, 10**30],
+        }
+        np.testing.assert_array_equal(
+            tokenize_schemas([s])[0], tokenize_schema_py(s)
+        )
+
+    def test_single_schema_entry_point_matches(self):
+        from kcp_tpu.ops.schemahash import tokenize_schema, tokenize_schema_py
+
+        s = {"type": "object", "properties": {"a": {"type": "string"}}}
+        np.testing.assert_array_equal(tokenize_schema(s), tokenize_schema_py(s))
+
+
 class TestEncoderParity:
     OBJS = [
         {"apiVersion": "v1", "kind": "ConfigMap",

@@ -17,6 +17,8 @@ from kcp_tpu.syncer import start_syncer
 from kcp_tpu.syncer.engine import CLUSTER_LABEL
 from kcp_tpu.utils.errors import NotFoundError, RetryableError
 
+from helpers import wait_until
+
 
 def cm(name, data, cluster_label="us-east1", ns="default"):
     return {
@@ -28,21 +30,19 @@ def cm(name, data, cluster_label="us-east1", ns="default"):
 
 
 async def eventually(pred, timeout=5.0, interval=0.01):
-    deadline = asyncio.get_event_loop().time() + timeout
-    while True:
+    def quiet_pred():
         try:
-            if pred():
-                return
+            return pred()
         except Exception:
-            pass
-        if asyncio.get_event_loop().time() > deadline:
-            pred_result = None
-            try:
-                pred_result = pred()
-            except Exception as e:  # noqa: BLE001
-                pred_result = f"raised {e!r}"
-            raise AssertionError(f"condition not reached (last: {pred_result})")
-        await asyncio.sleep(interval)
+            return False
+
+    if await wait_until(quiet_pred, timeout, interval):
+        return
+    try:
+        pred_result = pred()
+    except Exception as e:  # noqa: BLE001
+        pred_result = f"raised {e!r}"
+    raise AssertionError(f"condition not reached (last: {pred_result})")
 
 
 @pytest.mark.parametrize("backend", ["tpu", "host"])
