@@ -75,7 +75,12 @@ TARGET_CHIP = TARGET_POD // 8
 B = int(os.environ.get("KCP_BENCH_ROWS", "131072"))  # pow2
 TENANTS = B // 13  # ~13 objects per logical cluster
 S = 64
-CHURN = 768  # new upstream-spec events per tick
+# new upstream-spec events per tick. KCP_BENCH_CHURN sweeps the event
+# rate for the headroom curve (BASELINE.md "event-rate headroom"): the
+# resident-fleet decision math is O(B) per tick, but staging, the packed
+# wire, and the applier pool are O(events) — this knob finds where they
+# take over.
+CHURN = int(os.environ.get("KCP_BENCH_CHURN", "768"))
 WARMUP_TICKS = 24
 SEGMENT_S = 8.0
 SEGMENTS = 3
@@ -101,6 +106,8 @@ def emit(result: dict) -> None:
 def result_json(rps: float, *, provisional: bool, stage: str,
                 segments: list[float] | None = None,
                 p50_ms: float | None = None, p99_ms: float | None = None,
+                strict_p99_ms: float | None = None,
+                diags: dict | None = None,
                 note: str | None = None) -> dict:
     out = {
         "metric": "reconciles_per_sec",
@@ -112,6 +119,10 @@ def result_json(rps: float, *, provisional: bool, stage: str,
         "target_per_chip": TARGET_CHIP,
         "stage": stage,
     }
+    if CHURN != 768:
+        out["churn_per_tick"] = CHURN
+    if B != 131072:
+        out["rows"] = B
     if "--pallas" in sys.argv or os.environ.get("KCP_PALLAS", "") == "1":
         out["pallas"] = True
     if provisional:
@@ -124,6 +135,14 @@ def result_json(rps: float, *, provisional: bool, stage: str,
         out["convergence_p50_ms"] = round(p50_ms, 1)
         out["convergence_p99_ms"] = round(p99_ms, 1)
         out["convergence_target_ms"] = 200
+    if strict_p99_ms is not None:
+        # the round-3 window (close two dispatches AFTER the downstream
+        # write, proving the feedback re-scattered) — reported alongside
+        # the headline so the definition change is measurable, not
+        # merely disclosed (ADVICE r4)
+        out["convergence_strict_p99_ms"] = round(strict_p99_ms, 1)
+    if diags:
+        out.update(diags)
     if note:
         out["note"] = note
     return out
@@ -157,6 +176,8 @@ class _BenchOwner:
         self.t_create = np.full(b, time.perf_counter())
         self.dispatches = 0
         self.lat_ms: list[float] = []
+        self.lat_strict_ms: list[float] = []
+        self._strict_pending: list[tuple[int, np.ndarray]] = []
         self.patch_rows = 0
 
     # --------------------------------------------- SectionOwner interface
@@ -193,6 +214,12 @@ class _BenchOwner:
         rows = np.fromiter((k for k, _c, _u in patches), np.int32, len(patches))
         self.patch_rows += rows.size
         self.lat_ms.extend((now - self.t_create[rows]) * 1e3)
+        # strict (round-3) window: the same rows also close two
+        # dispatches later, once the feedback provably re-scattered
+        self._strict_pending.append((self.dispatches, self.t_create[rows].copy()))
+        while self._strict_pending and self.dispatches >= self._strict_pending[0][0] + 2:
+            _, creates = self._strict_pending.pop(0)
+            self.lat_strict_ms.extend((now - creates) * 1e3)
         self.bucket.down_vals[rows] = self.bucket.up_vals[rows]
         self.core.enqueue_many(self.section, True, rows.tolist())
 
@@ -317,20 +344,27 @@ def main() -> int:
 
         # ---- measurement: short segments, best-so-far after each
         owner.lat_ms.clear()
+        owner.lat_strict_ms.clear()
+        owner._strict_pending.clear()
         owner.patch_rows = 0
         seg_rates: list[float] = []
 
-        async def churn_pump(budget_s: float) -> bool:
-            """One churn batch per core tick; True if the device stalled.
+        async def churn_pump(budget_s: float) -> tuple[bool, float]:
+            """One churn batch per core tick; (stalled, max tick gap s).
 
             The time budget only ends the segment once at least one tick
             has landed — a zero-tick segment keeps waiting so a wedged
             device hits the STALL_S check instead of "completing" with
             nothing measured (the r03 hang ran 20 minutes dark this way).
+            The max inter-tick gap is the stall diagnostic: a segment
+            whose rate collapses but whose gap stays at ~tick time lost
+            throughput smoothly, while a multi-second gap is one discrete
+            stall (e.g. an unintended full re-upload or a recompile).
             """
             seg_start = time.perf_counter()
             last, progress = bucket.stats["ticks"], seg_start
             ticked = False
+            gap_max = 0.0
             # prime the loop: a fully-drained queue (fast ticks converge
             # everything between segments) would otherwise deadlock —
             # churn waits for a tick, the tick waits for events
@@ -338,29 +372,41 @@ def main() -> int:
             while True:
                 now = time.perf_counter()
                 if now - seg_start >= budget_s and ticked:
-                    return False
+                    return False, gap_max
                 t = bucket.stats["ticks"]
                 if t != last:
+                    gap_max = max(gap_max, now - progress)
                     last, progress, ticked = t, now, True
                     owner.emit_churn(CHURN)
                 elif now - progress > STALL_S:
-                    return True
+                    return True, max(gap_max, now - progress)
                 await asyncio.sleep(0.0002)
 
         stalled = False
         for seg in range(SEGMENTS):
             tick0 = bucket.stats["ticks"]
+            fu0 = bucket.stats["full_uploads"]
+            ov0 = bucket.stats["overflows"]
             t0 = time.perf_counter()
-            stalled = await churn_pump(SEGMENT_S)
+            stalled, gap_max = await churn_pump(SEGMENT_S)
             dt = time.perf_counter() - t0
             ticks = bucket.stats["ticks"] - tick0
             if ticks > 0:
                 seg_rates.append(B * ticks / dt)
             lat = np.asarray(owner.lat_ms)
             pcts = np.percentile(lat, [50, 99]) if lat.size else (None, None)
+            strict = np.asarray(owner.lat_strict_ms)
+            strict_p99 = float(np.percentile(strict, 99)) if strict.size else None
             value = float(np.median(seg_rates)) if seg_rates else warmup_rate
+            diags = {
+                "full_uploads_delta": bucket.stats["full_uploads"] - fu0,
+                "overflows_delta": bucket.stats["overflows"] - ov0,
+                "max_tick_gap_ms": round(gap_max * 1e3, 1),
+            }
             print(f"segment {seg + 1}/{SEGMENTS}: {ticks} ticks in {dt:.1f}s "
-                  f"({dt / max(ticks, 1) * 1e3:.1f} ms/tick)"
+                  f"({dt / max(ticks, 1) * 1e3:.1f} ms/tick, "
+                  f"max gap {gap_max * 1e3:.0f} ms, "
+                  f"+{diags['full_uploads_delta']} full uploads)"
                   + (" [STALLED]" if stalled else ""), file=sys.stderr)
             note = None
             if stalled:
@@ -373,6 +419,8 @@ def main() -> int:
                 stage=f"segment-{seg + 1}", segments=seg_rates,
                 p50_ms=float(pcts[0]) if pcts[0] is not None else None,
                 p99_ms=float(pcts[1]) if pcts[1] is not None else None,
+                strict_p99_ms=strict_p99,
+                diags=diags,
                 note=note)
             emit(best["result"])
             if stalled:

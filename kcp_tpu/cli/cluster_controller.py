@@ -32,6 +32,10 @@ def build_parser():
                    help="CA bundle for an https --server")
     p.add_argument("--resources-to-sync", default="deployments.apps")
     p.add_argument("--syncer-mode", choices=["push", "pull", "none"], default="push")
+    p.add_argument("--syncer-image", default="",
+                   help="image the pull-mode installer deploys (default: the "
+                        "installer's DEFAULT_SYNCER_IMAGE; see "
+                        "contrib/syncer-image)")
     p.add_argument("--auto-publish-apis", action="store_true")
     p.add_argument("--backend", choices=["tpu", "host"], default="tpu",
                    help="reconcile decision backend (batched device kernels "
@@ -66,7 +70,9 @@ async def run(args) -> None:
             resources_to_sync=[r for r in args.resources_to_sync.split(",") if r],
             mode=mode, backend=args.backend,
             poll_interval=args.poll_interval,
-            import_poll_interval=args.poll_interval),
+            import_poll_interval=args.poll_interval,
+            **({"syncer_image": args.syncer_image}
+               if args.syncer_image else {})),
         DeploymentSplitter(client),
     ]
     for c in controllers:

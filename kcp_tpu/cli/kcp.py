@@ -49,6 +49,20 @@ def build_parser():
                             "(reference: --auto_publish_apis)")
     start.add_argument("--resources-to-sync", default="deployments.apps",
                        help="comma-separated resources synced to physical clusters")
+    start.add_argument("--store-server", default="",
+                       help="serve against another kcp-tpu server's "
+                            "storage (the --etcd-servers analog): this "
+                            "process becomes a stateless frontend; run "
+                            "controllers on exactly one process")
+    start.add_argument("--store-token", default="",
+                       help="bearer token for an RBAC-enabled storage "
+                            "backend")
+    start.add_argument("--store-ca-file", default=None,
+                       help="CA bundle for a TLS storage backend")
+    start.add_argument("--syncer-image", default="",
+                       help="image the pull-mode installer deploys into "
+                            "physical clusters (default: the installer's "
+                            "DEFAULT_SYNCER_IMAGE; see contrib/syncer-image)")
     start.add_argument("--syncer-mode", choices=["push", "pull", "none"],
                        default="push")
     start.add_argument("--poll-interval", type=float, default=60.0,
@@ -102,6 +116,10 @@ def config_from_args(args) -> Config:
         auto_publish_apis=args.auto_publish_apis,
         resources_to_sync=[r for r in args.resources_to_sync.split(",") if r],
         syncer_mode=args.syncer_mode,
+        syncer_image=args.syncer_image,
+        store_server=args.store_server,
+        store_token=args.store_token,
+        store_ca_file=args.store_ca_file,
         poll_interval=args.poll_interval,
         import_poll_interval=args.poll_interval,
         authz=args.authz,

@@ -34,6 +34,7 @@ from ...syncer import Syncer
 from ...utils import errors
 from ..cluster.apiimporter import APIImporter
 from . import installer
+from .installer import DEFAULT_SYNCER_IMAGE
 
 log = logging.getLogger(__name__)
 
@@ -58,7 +59,7 @@ class ClusterController:
         poll_interval: float = DEFAULT_POLL_INTERVAL,
         import_poll_interval: float | None = None,
         kcp_kubeconfig: str = "",
-        syncer_image: str = "kcp-tpu/syncer:latest",
+        syncer_image: str = DEFAULT_SYNCER_IMAGE,
         mesh=None,
         mesh_spec: str = "",
     ):

@@ -21,6 +21,9 @@ log = logging.getLogger(__name__)
 
 SYNCER_NAMESPACE = "kcp-syncer"
 SYNCER_NAME = "syncer"
+# the one definition of the default pull-mode image (contrib/syncer-image);
+# Config, both CLIs, and the controller import it
+DEFAULT_SYNCER_IMAGE = "kcp-tpu/syncer:latest"
 
 
 def syncer_manifests(
@@ -91,7 +94,7 @@ def syncer_manifests(
 
 def install_syncer(
     physical: Client, cluster_name: str, kcp_kubeconfig: str,
-    resources: list[str], image: str = "kcp-tpu/syncer:latest",
+    resources: list[str], image: str = DEFAULT_SYNCER_IMAGE,
     mesh_spec: str = "",
 ) -> None:
     for gvr, obj in syncer_manifests(cluster_name, kcp_kubeconfig, resources,

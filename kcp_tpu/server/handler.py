@@ -62,6 +62,38 @@ class RestHandler:
         # (reference: the apiserver's readiness reflects post-start hooks,
         # server.go:179-256)
         self.ready = False
+        # external-storage frontends: every store verb is a blocking HTTP
+        # round trip to the backend, so it must not run on the serving
+        # loop (one slow backend call would freeze every request, watch
+        # stream, and health probe). A small pool bounds concurrency;
+        # in-process stores stay inline (in-memory, and the race guard
+        # expects loop-thread affinity).
+        self._store_pool = None
+        if getattr(store, "is_remote", False):
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._store_pool = ThreadPoolExecutor(
+                max_workers=8, thread_name_prefix="store-io")
+
+    async def _st(self, fn, *args, **kwargs):
+        """Run a store call; offloaded to the I/O pool for remote stores."""
+        if self._store_pool is None:
+            return fn(*args, **kwargs)
+        import asyncio
+        import functools
+
+        return await asyncio.get_running_loop().run_in_executor(
+            self._store_pool, functools.partial(fn, *args, **kwargs))
+
+    def _server_scope_allowed(self, req) -> bool:
+        """True when the caller may read server-global (cross-tenant)
+        state: always in open mode, else the /debug wildcard-read gate."""
+        if self.authorizer is None:
+            return True
+        from ..store.store import WILDCARD
+
+        user = self.authenticator.user_for(req.headers)
+        return self.authorizer.allowed(user, WILDCARD, "get", "", "debug")
 
     # ------------------------------------------------------------- routing
 
@@ -81,7 +113,30 @@ class RestHandler:
                 return Response(body=b"ok", content_type="text/plain")
             return Response(status=500, body=b"not ready", content_type="text/plain")
         if head == "version":
-            return Response.of_json(self.version_info)
+            # resourceVersion rides along so a storage-frontend peer
+            # (store/remote.py) can probe the store's current RV with one
+            # cheap GET instead of listing anything. The RV is global
+            # (cross-tenant) state, so with authz on it is only included
+            # for callers holding the same wildcard read /debug carries —
+            # the version fields themselves stay public, as on the real
+            # apiserver.
+            body = dict(self.version_info)
+            if self._server_scope_allowed(req):
+                body["resourceVersion"] = str(
+                    await self._st(lambda: self.store.resource_version))
+            return Response.of_json(body)
+        if head == "clusters" and len(segs) == 1:
+            # index of live logical clusters (the store's tenant set) —
+            # used by wildcard single-object reads on storage frontends.
+            # The tenant list is exactly what per-tenant RBAC is meant to
+            # hide, so it is gated like /debug (server-global read).
+            if not self._server_scope_allowed(req):
+                user = self.authenticator.user_for(req.headers)
+                return Response.of_json(
+                    _status_body(403, "Forbidden",
+                                 f'user "{user}" cannot list clusters'), 403)
+            return Response.of_json(
+                {"clusters": await self._st(self.store.clusters)})
         if head == "metrics":
             from ..utils.trace import REGISTRY
 
@@ -157,7 +212,7 @@ class RestHandler:
                                      f'user "{user}" cannot read the openapi '
                                      f'document of logical cluster "{cluster}"'),
                         403)
-            return Response.of_json(self._openapi_v2(cluster))
+            return Response.of_json(await self._st(self._openapi_v2, cluster))
         return _error_response(errors.NotFoundError(f"unknown path {req.path}"))
 
     async def _route_apis(self, req: Request, cluster: str, segs: list[str]):
@@ -312,7 +367,8 @@ class RestHandler:
                 if req.param("watch") in ("true", "1"):
                     return self._watch(req, cluster, res, namespace or None)
                 selector = parse_selector(req.param("labelSelector"))
-                items, rv = self.store.list(res, cluster, namespace or None, selector)
+                items, rv = await self._st(
+                    self.store.list, res, cluster, namespace or None, selector)
                 if as_table:  # kubectl get: server-side printer columns
                     return Response.of_json(render_table(res, items, rv))
                 return Response.of_json({
@@ -320,8 +376,8 @@ class RestHandler:
                     "metadata": {"resourceVersion": str(rv)},
                     "items": items,
                 })
-            obj = self.store.get(res, self._read_cluster(cluster, res, name, namespace),
-                                 name, namespace)
+            target = await self._read_cluster(cluster, res, name, namespace)
+            obj = await self._st(self.store.get, res, target, name, namespace)
             # no table transform for the status subresource (matches the
             # real apiserver: table rendering applies to objects, not
             # subresources)
@@ -332,7 +388,7 @@ class RestHandler:
         if req.method == "POST" and name is None:
             obj = self._body_object(req)
             target = resolve_write_cluster(cluster, obj, errors.BadRequestError)
-            created = self.store.create(res, target, obj, namespace)
+            created = await self._st(self.store.create, res, target, obj, namespace)
             return Response.of_json(self._stamp(created, info, gv), 201)
 
         if req.method == "PUT" and name is not None:
@@ -343,14 +399,15 @@ class RestHandler:
                     f"name in URL ({name}) does not match name in object ({body_name})")
             target = resolve_write_cluster(cluster, obj, errors.BadRequestError)
             if subresource == "status":
-                updated = self.store.update_status(res, target, obj, namespace)
+                updated = await self._st(
+                    self.store.update_status, res, target, obj, namespace)
             else:
-                updated = self.store.update(res, target, obj, namespace)
+                updated = await self._st(self.store.update, res, target, obj, namespace)
             return Response.of_json(self._stamp(updated, info, gv))
 
         if req.method == "DELETE" and name is not None:
-            target = self._read_cluster(cluster, res, name, namespace)
-            self.store.delete(res, target, name, namespace)
+            target = await self._read_cluster(cluster, res, name, namespace)
+            await self._st(self.store.delete, res, target, name, namespace)
             return Response.of_json(_status_body(200, "Deleted", f"{res} {name} deleted"))
 
         raise errors.BadRequestError(f"unsupported method {req.method} for {req.path}")
@@ -370,9 +427,15 @@ class RestHandler:
         obj.setdefault("apiVersion", gv)
         return obj
 
-    def _read_cluster(self, cluster: str, res: str, name: str, namespace: str) -> str:
+    async def _read_cluster(self, cluster: str, res: str, name: str,
+                            namespace: str) -> str:
         """Wildcard single-object reads scan tenants for the unique owner."""
         if cluster != WILDCARD:
+            return cluster
+        if self._store_pool is not None:
+            # storage frontend: the backend's own handler resolves '*'
+            # (this same scan, against its in-memory index) — forwarding
+            # the wildcard costs one round trip instead of tenants+1
             return cluster
         matches = [c for c in self.store.clusters()
                    if self._exists(res, c, name, namespace)]
@@ -440,6 +503,16 @@ class RestHandler:
                         step = min(step, max(0.0, deadline - loop.time()))
                     try:
                         ev = await asyncio.wait_for(it.__anext__(), timeout=step)
+                    except errors.ConflictError as e:
+                        # remote-store frontends surface an expired watch
+                        # window from the first iteration (the backend's
+                        # 410 arrives in-stream) rather than from watch()
+                        # — translate it the same way so clients relist
+                        # instead of seeing a silent connection drop
+                        await stream.send_json({
+                            "type": "ERROR",
+                            "object": _status_body(410, "Expired", e.message)})
+                        return
                     except asyncio.TimeoutError:
                         if deadline is not None and loop.time() >= deadline:
                             return  # server-side watch timeout: clean close
@@ -450,10 +523,12 @@ class RestHandler:
                         if bookmarks and not watch.pending():
                             # progress marker carrying the current RV so
                             # clients can resume without replay
+                            rv_now = await self._st(
+                                lambda: self.store.resource_version)
                             await stream.send_json({
                                 "type": "BOOKMARK",
                                 "object": {"kind": "Bookmark", "metadata": {
-                                    "resourceVersion": str(self.store.resource_version)}},
+                                    "resourceVersion": str(rv_now)}},
                             })
                         continue
                     except StopAsyncIteration:

@@ -206,6 +206,12 @@ class RestWatch:
             out.append(ev)
         return out
 
+    def pending(self) -> int:
+        """Buffered event count (may include the end-of-stream sentinel);
+        part of the Watch duck type — the handler's watch streamer emits
+        bookmarks only when a watch has nothing pending."""
+        return self._events.qsize()
+
     @property
     def closed(self) -> bool:
         return self._closed

@@ -33,10 +33,22 @@ class Config:
     listen_host: str = "127.0.0.1"
     listen_port: int = 0  # 0 = ephemeral (reference default is 6443)
     durable: bool = True  # WAL-backed store vs in-memory
+    store_server: str = ""  # external-storage option (the reference's
+    # kcp start --etcd-servers, server.go:263-291): serve against another
+    # kcp-tpu server's storage over REST instead of embedding a store.
+    # Durability and storage semantics belong to that backend; run
+    # controllers on exactly one process.
+    store_token: str = ""  # bearer token for an authz'd storage backend
+    store_ca_file: str | None = None  # CA for a TLS storage backend
     install_controllers: bool = True  # in-proc controllers (kcp start default)
     auto_publish_apis: bool = False  # --auto_publish_apis flag analog
     resources_to_sync: list[str] = field(default_factory=lambda: ["deployments.apps"])
     syncer_mode: str = "push"  # push | pull | none (controller.go:42-48)
+    syncer_image: str = ""  # pull-mode image the installer deploys
+    # (contrib/syncer-image/Dockerfile; reference: the cluster
+    # controller's syncer-image flag). Empty = installer.
+    # DEFAULT_SYNCER_IMAGE — resolved at wiring time to keep the one
+    # definition in installer.py
     poll_interval: float = 15.0
     import_poll_interval: float = 15.0
     authz: bool = False  # RBAC-lite enforcement (server/authz.py); the
@@ -61,16 +73,25 @@ class Server:
         self.config = config or Config()
         self.scheme = scheme or default_scheme()
         self.registry = registry or PhysicalRegistry()
-        wal = None
-        if self.config.durable:
-            os.makedirs(self.config.root_dir, exist_ok=True)
-            wal = os.path.join(self.config.root_dir, "store.wal")
-        # finalizer stamping is only safe when the namespace controller
-        # that releases it will run (install_controllers)
-        self.store = LogicalStore(
-            wal_path=wal,
-            namespace_lifecycle=self.config.install_controllers,
-        )
+        if self.config.store_server:
+            # external storage: this process is a stateless frontend; the
+            # backend's store owns RVs, conflicts, finalizers, and the WAL
+            from ..store.remote import RemoteStore
+
+            self.store = RemoteStore(self.config.store_server,
+                                     token=self.config.store_token,
+                                     ca_file=self.config.store_ca_file)
+        else:
+            wal = None
+            if self.config.durable:
+                os.makedirs(self.config.root_dir, exist_ok=True)
+                wal = os.path.join(self.config.root_dir, "store.wal")
+            # finalizer stamping is only safe when the namespace
+            # controller that releases it will run (install_controllers)
+            self.store = LogicalStore(
+                wal_path=wal,
+                namespace_lifecycle=self.config.install_controllers,
+            )
         authn = authz = None
         if self.config.authz:
             import secrets as _secrets
@@ -182,6 +203,8 @@ class Server:
                 mode=mode, poll_interval=self.config.poll_interval,
                 import_poll_interval=self.config.import_poll_interval,
                 mesh=mesh, mesh_spec=self.config.mesh,
+                **({"syncer_image": self.config.syncer_image}
+                   if self.config.syncer_image else {}),
             ),
             DeploymentSplitter(self.client),
             # the reference's "start-namespace-controller" hook

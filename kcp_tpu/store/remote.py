@@ -1,0 +1,157 @@
+"""RemoteStore — serve this process against another server's storage.
+
+The reference's ``kcp start --etcd-servers`` skips the embedded etcd and
+points the apiserver at shared external storage (reference:
+pkg/server/server.go:263-291), so several frontends can serve one
+dataset. The analog here: a :class:`RemoteStore` implements the
+:class:`~kcp_tpu.store.store.LogicalStore` verb surface by delegating
+every call to a *backend* kcp-tpu server over its REST API
+(``kcp start --store-server https://backend:6443``). Storage semantics —
+RV allocation, conflict detection, generation bumps, finalizers, watch
+history windows — are enforced once, by the backend's real store; this
+class is a transport, not a second implementation.
+
+Division of labor when a frontend serves this way:
+- reads/writes/watches pass through (one RestClient per logical cluster,
+  kept-alive; watches ride the ndjson stream);
+- the frontend runs NO WAL and takes no snapshots (``snapshot`` is a
+  no-op) — durability is the backend's;
+- controllers: run them on exactly one process (usually the backend;
+  start frontends with --no-install-controllers) or they will fight over
+  the same objects, the same rule the reference has for running several
+  kcp replicas against one etcd.
+
+Caveat vs the in-process store: an expired watch window surfaces as a
+``ConflictError`` on the first iteration of the returned watch rather
+than synchronously from :meth:`watch` (the stream error arrives with the
+backend's response) — informer relists handle both shapes.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+from .selectors import LabelSelector
+from .store import WILDCARD
+
+DEFAULT_CLUSTER = "default"
+
+
+class RemoteStore:
+    """LogicalStore-surface adapter over a backend server's REST API."""
+
+    # handler capability flag: verbs are blocking network I/O (offload
+    # from the serving loop) and the backend resolves wildcard reads
+    # itself (skip the local tenant scan)
+    is_remote = True
+
+    def __init__(self, base_url: str, token: str = "",
+                 ca_data: bytes | str | None = None,
+                 ca_file: str | None = None):
+        # deferred import: store/ must not import server/ at module load
+        # (server imports store)
+        from ..server.rest import RestClient
+
+        self._root = RestClient(base_url, cluster=WILDCARD, token=token,
+                                ca_data=ca_data, ca_file=ca_file)
+        # LRU of per-cluster clients: each holds one kept-alive
+        # connection, and a frontend can be asked about arbitrarily many
+        # tenants — bound the pool instead of leaking a socket per tenant
+        self._scoped: "OrderedDict[str, object]" = OrderedDict(
+            {WILDCARD: self._root})
+        self._scoped_cap = 256
+        self.base_url = base_url
+        # LogicalStore duck-type attributes the handler/client read
+        self.openapi_doc: dict | None = None
+        self.namespace_lifecycle = False  # backend stamps finalizers
+
+    # ---------------------------------------------------------- plumbing
+
+    def _client(self, cluster: str):
+        c = self._scoped.get(cluster)
+        if c is None:
+            c = self._root.scoped(cluster)
+            self._scoped[cluster] = c
+            if len(self._scoped) > self._scoped_cap:
+                _, evicted = self._scoped.popitem(last=False)
+                evicted.close()
+        else:
+            self._scoped.move_to_end(cluster)
+        return c
+
+    # ------------------------------------------------------------- verbs
+
+    def create(self, resource: str, cluster: str, obj: dict,
+               namespace: str = "") -> dict:
+        return self._client(cluster).create(resource, obj, namespace)
+
+    def get(self, resource: str, cluster: str, name: str,
+            namespace: str = "") -> dict:
+        return self._client(cluster).get(resource, name, namespace)
+
+    def update(self, resource: str, cluster: str, obj: dict,
+               namespace: str = "", subresource: str | None = None) -> dict:
+        client = self._client(cluster)
+        if subresource == "status":
+            return client.update_status(resource, obj, namespace)
+        if subresource is not None:
+            raise ValueError(f"unknown subresource {subresource!r}")
+        return client.update(resource, obj, namespace)
+
+    def update_status(self, resource: str, cluster: str, obj: dict,
+                      namespace: str = "") -> dict:
+        return self.update(resource, cluster, obj, namespace,
+                           subresource="status")
+
+    def delete(self, resource: str, cluster: str, name: str,
+               namespace: str = "") -> None:
+        client = self._client(cluster)
+        if cluster == WILDCARD:
+            # RestClient refuses wildcard deletes (an in-process store
+            # needs an explicit tenant), but here the backend's handler
+            # resolves '*' to the unique owner exactly as a frontend
+            # would have — forward it
+            client._request(
+                "DELETE", client._path(resource, namespace, name, cluster=cluster))
+            return
+        client.delete(resource, name, namespace, cluster=cluster)
+
+    def list(self, resource: str, cluster: str = WILDCARD,
+             namespace: str | None = None,
+             selector: LabelSelector | None = None) -> tuple[list[dict], int]:
+        return self._client(cluster).list(resource, namespace, selector)
+
+    def watch(self, resource: str, cluster: str = WILDCARD,
+              namespace: str | None = None,
+              selector: LabelSelector | None = None,
+              since_rv: int | None = None):
+        return self._client(cluster).watch(resource, namespace, selector,
+                                           since_rv=since_rv)
+
+    # --------------------------------------------------------- inventory
+
+    @property
+    def resource_version(self) -> int:
+        body = self._root._request("GET", "/version")
+        return int(body.get("resourceVersion", "0"))
+
+    def resources(self) -> list[str]:
+        return self._root.resources()
+
+    def clusters(self) -> list[str]:
+        body = self._root._request("GET", "/clusters")
+        return list(body.get("clusters", []))
+
+    def __len__(self) -> int:
+        # only inventory surfaces (kcp snapshot) use this; a wildcard
+        # list per resource is acceptable there and wrong to cache
+        return sum(len(self.list(r)[0]) for r in self.resources())
+
+    # ---------------------------------------------------------- lifecycle
+
+    def snapshot(self) -> None:
+        """No-op: durability belongs to the backend's store."""
+
+    def close(self) -> None:
+        for c in self._scoped.values():
+            c.close()

@@ -1,0 +1,42 @@
+#!/usr/bin/env bash
+# CI pipeline — the analog of the reference's committed workflows
+# (.github/workflows/ci.yaml: vet + race-checked tests; demos.yaml:
+# golden demo runs). A fresh checkout runs this green; every stage is
+# CPU-pinned (tests via conftest, demo via DEMO_JAX_PLATFORM, dryrun via
+# its XLA_FLAGS guard) so it is safe to run while a TPU bench is in
+# flight elsewhere.
+#
+# Usage: scripts/ci.sh [--fast]   (--fast skips the demo + dryrun)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+fast=0
+[[ "${1:-}" == "--fast" ]] && fast=1
+
+echo "== vet: syntax-compile every tracked python file"
+python -m compileall -q kcp_tpu tests contrib bench.py __graft_entry__.py
+
+if command -v ruff >/dev/null 2>&1; then
+    echo "== lint: ruff (present on this host)"
+    ruff check kcp_tpu tests bench.py __graft_entry__.py
+else
+    echo "== lint: ruff not installed here, skipped (vet stage above still gates syntax)"
+fi
+
+echo "== native: build libkcpnative.so + kcptok extension"
+make -s -C native
+make -s -C native kcptok.so
+
+echo "== tests: full suite, race-checked (KCP_RACE=1 via conftest)"
+python -m pytest tests/ -q
+
+if [[ "$fast" == "0" ]]; then
+    echo "== demo: both golden scenarios, checked against committed output"
+    python contrib/demo/run_demo.py all --check
+
+    echo "== dryrun: full serving step jit + one tick on a virtual 8-device mesh"
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+        python -c "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)"
+fi
+
+echo "CI OK"

@@ -133,6 +133,41 @@ def test_handler_enforces_rbac():
     asyncio.run(main())
 
 
+def test_server_global_surfaces_gated():
+    """/clusters (tenant enumeration) and the RV in /version are
+    cross-tenant state: gated like /debug when authz is on."""
+    async def main():
+        store = LogicalStore()
+        authn = Authenticator(tokens={"admin-tok": "admin", "alice-tok": "alice"})
+        handler = RestHandler(store, default_scheme(),
+                              authenticator=authn, authorizer=Authorizer(store))
+        store.create("configmaps", "team-a", {"metadata": {"name": "x"}})
+
+        # anonymous: no tenant list, version without RV (but still 200)
+        resp = await handler(_req("GET", "/clusters"))
+        assert resp.status == 403
+        resp = await handler(_req("GET", "/version"))
+        assert resp.status == 200
+        assert b"resourceVersion" not in resp.body
+
+        # admin sees both
+        hdr = {"authorization": "Bearer admin-tok"}
+        resp = await handler(_req("GET", "/clusters", hdr))
+        assert resp.status == 200 and b"team-a" in resp.body
+        resp = await handler(_req("GET", "/version", hdr))
+        assert b"resourceVersion" in resp.body
+
+        # a tenant-scoped user is still not a fleet reader
+        _grant(store, "team-a", "alice", "cm-reader", rules=[
+            {"verbs": ["*"], "apiGroups": ["*"], "resources": ["*"]},
+        ])
+        hdr = {"authorization": "Bearer alice-tok"}
+        resp = await handler(_req("GET", "/clusters", hdr))
+        assert resp.status == 403
+
+    asyncio.run(main())
+
+
 def test_handler_open_without_authorizer():
     async def main():
         handler = RestHandler(LogicalStore(), default_scheme())

@@ -54,6 +54,19 @@ def test_parse_installed_syncer_forwards_mesh_spec():
     assert mesh_spec == "4x2"
 
 
+def test_custom_syncer_image_reaches_manifest():
+    """--syncer-image (Config.syncer_image) names the image the installed
+    Deployment runs — the deploy-a-real-image story
+    (contrib/syncer-image/Dockerfile)."""
+    phys = Client(LogicalStore(), "pcluster")
+    installer.install_syncer(phys, "east", "kcp://test-kubeconfig",
+                             ["configmaps"], image="registry.example/kcp-tpu/syncer:v9")
+    dep = phys.get("deployments.apps", installer.SYNCER_NAME,
+                   installer.SYNCER_NAMESPACE)
+    image = dep["spec"]["template"]["spec"]["containers"][0]["image"]
+    assert image == "registry.example/kcp-tpu/syncer:v9"
+
+
 def test_parse_uninstalled_raises():
     phys = Client(LogicalStore(), "pcluster")
     with pytest.raises(PodSpecError, match="not installed"):

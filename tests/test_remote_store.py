@@ -1,0 +1,154 @@
+"""External-storage option: a frontend server serving against another
+server's storage (kcp start --store-server — the reference's
+--etcd-servers analog, pkg/server/server.go:263-291).
+
+Two full server processes (threads) share one dataset: writes through
+either are visible through both, storage semantics (RV conflicts) are
+enforced once by the backend, and watches stream through the frontend.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from kcp_tpu.server.rest import RestClient
+from kcp_tpu.server.server import Config
+from kcp_tpu.server.threaded import ServerThread
+from kcp_tpu.store.remote import RemoteStore
+from kcp_tpu.utils import errors
+
+
+@pytest.fixture()
+def pair(tmp_path):
+    with ServerThread(Config(durable=False, install_controllers=False)) as backend:
+        ca = tmp_path / "backend-ca.crt"
+        ca.write_bytes(backend.ca_pem)
+        with ServerThread(Config(durable=False, install_controllers=False,
+                                 store_server=backend.address,
+                                 store_ca_file=str(ca))) as frontend:
+            yield backend, frontend
+
+
+def cm(name, cluster, data):
+    return {"apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": name, "namespace": "default",
+                         "clusterName": cluster},
+            "data": data}
+
+
+def test_writes_visible_through_both(pair):
+    backend, frontend = pair
+    fc = RestClient(frontend.address, ca_data=frontend.ca_pem, cluster="t1")
+    bc = RestClient(backend.address, ca_data=backend.ca_pem, cluster="t1")
+
+    created = fc.create("configmaps", cm("via-front", "t1", {"a": "1"}))
+    assert created["metadata"]["resourceVersion"]
+    assert bc.get("configmaps", "via-front", "default")["data"] == {"a": "1"}
+
+    bc.create("configmaps", cm("via-back", "t1", {"b": "2"}))
+    assert fc.get("configmaps", "via-back", "default")["data"] == {"b": "2"}
+
+    items, rv = fc.list("configmaps")
+    assert {o["metadata"]["name"] for o in items} == {"via-front", "via-back"}
+    assert rv > 0
+
+
+def test_conflicts_enforced_once_by_backend(pair):
+    _backend, frontend = pair
+    fc = RestClient(frontend.address, ca_data=frontend.ca_pem, cluster="t1")
+    obj = fc.create("configmaps", cm("c", "t1", {"v": "1"}))
+    stale = dict(obj, data={"v": "stale"})
+    fresh = dict(obj, data={"v": "2"})
+    fc.update("configmaps", fresh)
+    with pytest.raises(errors.ConflictError):
+        fc.update("configmaps", stale)
+    # delete through the frontend is real
+    fc.delete("configmaps", "c", "default")
+    with pytest.raises(errors.NotFoundError):
+        fc.get("configmaps", "c", "default")
+
+
+def test_watch_streams_through_frontend(pair):
+    backend, frontend = pair
+
+    async def main():
+        fc = RestClient(frontend.address, ca_data=frontend.ca_pem, cluster="tw")
+        bc = RestClient(backend.address, ca_data=backend.ca_pem, cluster="tw")
+        w = fc.watch("configmaps")
+        try:
+            # prime the stream (RestWatch connects lazily on first read),
+            # give the frontend a beat to subscribe against the backend,
+            # then write through the BACKEND
+            await w.next_batch(0.05)
+            await asyncio.sleep(0.3)
+            bc.create("configmaps", cm("seen", "tw", {"x": "y"}))
+            got = []
+            for _ in range(100):
+                got.extend(ev for ev in await w.next_batch(0.05))
+                if got:
+                    break
+            assert got and got[0].object["metadata"]["name"] == "seen"
+        finally:
+            w.close()
+
+    asyncio.run(main())
+
+
+def test_wildcard_read_passes_through(pair):
+    """A frontend forwards '*' single-object reads in ONE round trip; the
+    backend resolves the unique owner (or 400s on ambiguity)."""
+    backend, frontend = pair
+    bc1 = RestClient(backend.address, ca_data=backend.ca_pem, cluster="wa")
+    bc2 = RestClient(backend.address, ca_data=backend.ca_pem, cluster="wb")
+    bc1.create("configmaps", cm("only-in-wa", "wa", {"o": "1"}))
+    bc1.create("configmaps", cm("both", "wa", {}))
+    bc2.create("configmaps", cm("both", "wb", {}))
+
+    fw = RestClient(frontend.address, ca_data=frontend.ca_pem, cluster="*")
+    got = fw.get("configmaps", "only-in-wa", "default")
+    assert got["metadata"]["clusterName"] == "wa"
+    with pytest.raises(errors.BadRequestError):
+        fw.get("configmaps", "both", "default")
+    # wildcard delete over the frontend's HTTP surface resolves the
+    # unique owner backend-side too (RestClient itself refuses to *send*
+    # wildcard deletes, so issue the raw request the handler serves)
+    fw._request("DELETE",
+                "/clusters/*/api/v1/namespaces/default/configmaps/only-in-wa")
+    with pytest.raises(errors.NotFoundError):
+        fw.get("configmaps", "only-in-wa", "default")
+
+
+def test_expired_watch_window_surfaces_through_frontend(pair):
+    """The backend's 410 arrives mid-stream at the frontend; the frontend
+    must translate it to its own in-stream ERROR, not a silent drop."""
+    backend, frontend = pair
+    bc = RestClient(backend.address, ca_data=backend.ca_pem, cluster="tx")
+    for i in range(5):
+        bc.create("configmaps", cm(f"g{i}", "tx", {}))
+    backend.call(backend.server.store._history.clear)
+    bc.create("configmaps", cm("last", "tx", {}))
+
+    async def main():
+        fc = RestClient(frontend.address, ca_data=frontend.ca_pem, cluster="tx")
+        w = fc.watch("configmaps", since_rv=1)
+        with pytest.raises(errors.ConflictError):
+            await w.next_batch(max_wait=5.0)
+        w.close()
+
+    asyncio.run(main())
+
+
+def test_remote_store_inventory_probes(pair):
+    backend, frontend = pair
+    store = frontend.server.store
+    assert isinstance(store, RemoteStore)
+    fc = RestClient(frontend.address, ca_data=frontend.ca_pem, cluster="inv")
+    fc.create("configmaps", cm("one", "inv", {}))
+    assert "inv" in store.clusters()
+    rv1 = store.resource_version
+    assert rv1 > 0
+    fc.create("configmaps", cm("two", "inv", {}))
+    assert store.resource_version > rv1
+    assert "configmaps" in store.resources()
