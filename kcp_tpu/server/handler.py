@@ -85,15 +85,19 @@ class RestHandler:
         return await asyncio.get_running_loop().run_in_executor(
             self._store_pool, functools.partial(fn, *args, **kwargs))
 
-    def _server_scope_allowed(self, req) -> bool:
+    async def _server_scope_allowed(self, req) -> bool:
         """True when the caller may read server-global (cross-tenant)
-        state: always in open mode, else the /debug wildcard-read gate."""
+        state — /debug, /clusters, the RV in /version share this one
+        gate. Always true in open mode; the authz check itself goes
+        through :meth:`_st` because on a remote-store frontend the
+        Authorizer reads roles/bindings through the remote store."""
         if self.authorizer is None:
             return True
         from ..store.store import WILDCARD
 
         user = self.authenticator.user_for(req.headers)
-        return self.authorizer.allowed(user, WILDCARD, "get", "", "debug")
+        return await self._st(
+            self.authorizer.allowed, user, WILDCARD, "get", "", "debug")
 
     # ------------------------------------------------------------- routing
 
@@ -121,7 +125,7 @@ class RestHandler:
             # the version fields themselves stay public, as on the real
             # apiserver.
             body = dict(self.version_info)
-            if self._server_scope_allowed(req):
+            if await self._server_scope_allowed(req):
                 body["resourceVersion"] = str(
                     await self._st(lambda: self.store.resource_version))
             return Response.of_json(body)
@@ -130,7 +134,7 @@ class RestHandler:
             # used by wildcard single-object reads on storage frontends.
             # The tenant list is exactly what per-tenant RBAC is meant to
             # hide, so it is gated like /debug (server-global read).
-            if not self._server_scope_allowed(req):
+            if not await self._server_scope_allowed(req):
                 user = self.authenticator.user_for(req.headers)
                 return Response.of_json(
                     _status_body(403, "Forbidden",
@@ -148,16 +152,12 @@ class RestHandler:
             # + asyncio task dump + span histograms. Server-global, so
             # with authz on it is gated like cross-tenant reads (root
             # cluster-admin), matching pprof-on-the-secure-port semantics.
-            if self.authorizer is not None:
-                from ..store.store import WILDCARD
-
+            if not await self._server_scope_allowed(req):
                 user = self.authenticator.user_for(req.headers)
-                if not self.authorizer.allowed(user, WILDCARD, "get", "",
-                                               "debug"):
-                    return Response.of_json(
-                        _status_body(403, "Forbidden",
-                                     f'user "{user}" cannot read /debug/profile'),
-                        403)
+                return Response.of_json(
+                    _status_body(403, "Forbidden",
+                                 f'user "{user}" cannot read /debug/profile'),
+                    403)
             from ..utils.trace import sample_profile
 
             try:
@@ -168,15 +168,11 @@ class RestHandler:
         if head == "debug" and segs[1:] == ["trace"]:
             # on-demand XLA/device trace (xprof): the device-side half of
             # the profiling story. Same gate as /debug/profile.
-            if self.authorizer is not None:
-                from ..store.store import WILDCARD
-
+            if not await self._server_scope_allowed(req):
                 user = self.authenticator.user_for(req.headers)
-                if not self.authorizer.allowed(user, WILDCARD, "get", "",
-                                               "debug"):
-                    return Response.of_json(
-                        _status_body(403, "Forbidden",
-                                     f'user "{user}" cannot trace'), 403)
+                return Response.of_json(
+                    _status_body(403, "Forbidden",
+                                 f'user "{user}" cannot trace'), 403)
             import asyncio as _asyncio
             import tempfile
 
@@ -204,9 +200,9 @@ class RestHandler:
             # exactly like listing CRDs in that cluster
             if self.authorizer is not None:
                 user = self.authenticator.user_for(req.headers)
-                if not self.authorizer.allowed(
-                        user, cluster, "list", "apiextensions.k8s.io",
-                        "customresourcedefinitions"):
+                if not await self._st(
+                        self.authorizer.allowed, user, cluster, "list",
+                        "apiextensions.k8s.io", "customresourcedefinitions"):
                     return Response.of_json(
                         _status_body(403, "Forbidden",
                                      f'user "{user}" cannot read the openapi '
@@ -274,7 +270,8 @@ class RestHandler:
             # the operation that will actually run
             is_watch = name is None and req.param("watch") in ("true", "1")
             verb = verb_for(req.method, name is not None, is_watch)
-            if not self.authorizer.allowed(user, cluster, verb, group, resource):
+            if not await self._st(self.authorizer.allowed, user, cluster,
+                                  verb, group, resource):
                 return Response.of_json(
                     _status_body(403, "Forbidden",
                                  f'user "{user}" cannot {verb} {resource} '
@@ -292,7 +289,8 @@ class RestHandler:
                     # malformed bodies fall through to _serve_resource's
                     # 400; the check itself must not crash on them
                     body = None
-                denial = self.authorizer.escalation_denied(
+                denial = await self._st(
+                    self.authorizer.escalation_denied,
                     user, cluster, resource, body)
                 if denial:
                     return Response.of_json(
@@ -486,7 +484,8 @@ class RestHandler:
             import asyncio
 
             try:
-                watch = self.store.watch(res, cluster, namespace, selector, since_rv)
+                watch = await self._st(
+                    self.store.watch, res, cluster, namespace, selector, since_rv)
             except errors.ConflictError as e:
                 # expired watch window → 410 Gone in-stream, like the
                 # apiserver's "too old resource version"
@@ -522,9 +521,20 @@ class RestHandler:
                         # would skip that event forever
                         if bookmarks and not watch.pending():
                             # progress marker carrying the current RV so
-                            # clients can resume without replay
-                            rv_now = await self._st(
-                                lambda: self.store.resource_version)
+                            # clients can resume without replay. On a
+                            # remote-store frontend the store RV is ahead
+                            # of the relayed stream (an event can commit
+                            # backend-side while its chunk is still in
+                            # flight), so bookmark only what this stream
+                            # has DELIVERED (last_rv) — a fresher store
+                            # RV would let a resuming client skip that
+                            # in-flight event forever.
+                            if self._store_pool is not None:
+                                rv_now = getattr(watch, "last_rv", 0)
+                                if not rv_now:
+                                    continue  # nothing delivered yet
+                            else:
+                                rv_now = self.store.resource_version
                             await stream.send_json({
                                 "type": "BOOKMARK",
                                 "object": {"kind": "Bookmark", "metadata": {

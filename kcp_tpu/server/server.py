@@ -78,6 +78,20 @@ class Server:
             # backend's store owns RVs, conflicts, finalizers, and the WAL
             from ..store.remote import RemoteStore
 
+            if self.config.durable:
+                # no WAL here, but start() still writes admin.kubeconfig
+                # (and TLS persists pki/) under root_dir
+                os.makedirs(self.config.root_dir, exist_ok=True)
+            if self.config.install_controllers:
+                # legal but usually wrong: controllers on BOTH the
+                # frontend and the backend would fight over the same
+                # shared objects (run them on exactly one process)
+                log.warning(
+                    "--store-server with in-process controllers: make sure "
+                    "the storage backend (or any other frontend) is NOT "
+                    "also running controllers, or they will fight over the "
+                    "shared dataset; frontends usually take "
+                    "--no-install-controllers")
             self.store = RemoteStore(self.config.store_server,
                                      token=self.config.store_token,
                                      ca_file=self.config.store_ca_file)

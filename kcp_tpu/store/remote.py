@@ -29,6 +29,7 @@ backend's response) — informer relists handle both shapes.
 
 from __future__ import annotations
 
+import threading
 from collections import OrderedDict
 
 from .selectors import LabelSelector
@@ -54,11 +55,16 @@ class RemoteStore:
 
         self._root = RestClient(base_url, cluster=WILDCARD, token=token,
                                 ca_data=ca_data, ca_file=ca_file)
-        # LRU of per-cluster clients: each holds one kept-alive
-        # connection, and a frontend can be asked about arbitrarily many
-        # tenants — bound the pool instead of leaking a socket per tenant
-        self._scoped: "OrderedDict[str, object]" = OrderedDict(
-            {WILDCARD: self._root})
+        # Callers run verbs from a thread pool (the handler's store-I/O
+        # executor), but each RestClient owns ONE kept-alive connection
+        # and is not thread-safe — so every entry pairs a client with a
+        # lock, concurrency comes from different clusters proceeding in
+        # parallel, and the LRU map itself is guarded by _map_lock.
+        # Bounded so a frontend asked about arbitrarily many tenants
+        # doesn't leak a socket per tenant.
+        self._map_lock = threading.Lock()
+        self._scoped: "OrderedDict[str, tuple[object, threading.Lock]]" = (
+            OrderedDict({WILDCARD: (self._root, threading.Lock())}))
         self._scoped_cap = 256
         self.base_url = base_url
         # LogicalStore duck-type attributes the handler/client read
@@ -67,36 +73,54 @@ class RemoteStore:
 
     # ---------------------------------------------------------- plumbing
 
-    def _client(self, cluster: str):
-        c = self._scoped.get(cluster)
-        if c is None:
-            c = self._root.scoped(cluster)
-            self._scoped[cluster] = c
-            if len(self._scoped) > self._scoped_cap:
-                _, evicted = self._scoped.popitem(last=False)
-                evicted.close()
-        else:
-            self._scoped.move_to_end(cluster)
-        return c
+    def _entry(self, cluster: str):
+        with self._map_lock:
+            e = self._scoped.get(cluster)
+            if e is None:
+                e = (self._root.scoped(cluster), threading.Lock())
+                self._scoped[cluster] = e
+                if len(self._scoped) > self._scoped_cap:
+                    key, (evicted, elock) = self._scoped.popitem(last=False)
+                    if key == WILDCARD:
+                        # the root entry is load-bearing (RV/cluster
+                        # probes) — never evict it: re-insert as
+                        # most-recent and take the true oldest instead
+                        self._scoped[WILDCARD] = (evicted, elock)
+                        key, (evicted, elock) = self._scoped.popitem(last=False)
+                    # close only if idle; a client mid-request keeps its
+                    # socket until GC finalizes it (never yank a
+                    # connection out from under another thread)
+                    if elock.acquire(blocking=False):
+                        try:
+                            evicted.close()
+                        finally:
+                            elock.release()
+            else:
+                self._scoped.move_to_end(cluster)
+            return e
+
+    def _call(self, cluster: str, verb: str, *args, **kwargs):
+        client, lock = self._entry(cluster)
+        with lock:
+            return getattr(client, verb)(*args, **kwargs)
 
     # ------------------------------------------------------------- verbs
 
     def create(self, resource: str, cluster: str, obj: dict,
                namespace: str = "") -> dict:
-        return self._client(cluster).create(resource, obj, namespace)
+        return self._call(cluster, "create", resource, obj, namespace)
 
     def get(self, resource: str, cluster: str, name: str,
             namespace: str = "") -> dict:
-        return self._client(cluster).get(resource, name, namespace)
+        return self._call(cluster, "get", resource, name, namespace)
 
     def update(self, resource: str, cluster: str, obj: dict,
                namespace: str = "", subresource: str | None = None) -> dict:
-        client = self._client(cluster)
         if subresource == "status":
-            return client.update_status(resource, obj, namespace)
+            return self._call(cluster, "update_status", resource, obj, namespace)
         if subresource is not None:
             raise ValueError(f"unknown subresource {subresource!r}")
-        return client.update(resource, obj, namespace)
+        return self._call(cluster, "update", resource, obj, namespace)
 
     def update_status(self, resource: str, cluster: str, obj: dict,
                       namespace: str = "") -> dict:
@@ -105,41 +129,58 @@ class RemoteStore:
 
     def delete(self, resource: str, cluster: str, name: str,
                namespace: str = "") -> None:
-        client = self._client(cluster)
-        if cluster == WILDCARD:
-            # RestClient refuses wildcard deletes (an in-process store
-            # needs an explicit tenant), but here the backend's handler
-            # resolves '*' to the unique owner exactly as a frontend
-            # would have — forward it
-            client._request(
-                "DELETE", client._path(resource, namespace, name, cluster=cluster))
-            return
-        client.delete(resource, name, namespace, cluster=cluster)
+        client, lock = self._entry(cluster)
+        with lock:
+            if cluster == WILDCARD:
+                # RestClient refuses wildcard deletes (an in-process
+                # store needs an explicit tenant), but here the backend's
+                # handler resolves '*' to the unique owner exactly as a
+                # frontend would have — forward it
+                client._request(
+                    "DELETE",
+                    client._path(resource, namespace, name, cluster=cluster))
+                return
+            client.delete(resource, name, namespace, cluster=cluster)
 
     def list(self, resource: str, cluster: str = WILDCARD,
              namespace: str | None = None,
              selector: LabelSelector | None = None) -> tuple[list[dict], int]:
-        return self._client(cluster).list(resource, namespace, selector)
+        return self._call(cluster, "list", resource, namespace, selector)
 
     def watch(self, resource: str, cluster: str = WILDCARD,
               namespace: str | None = None,
               selector: LabelSelector | None = None,
               since_rv: int | None = None):
-        return self._client(cluster).watch(resource, namespace, selector,
-                                           since_rv=since_rv)
+        # watch construction may refresh discovery (a blocking request)
+        # before returning the lazily-connecting RestWatch, so it holds
+        # the cluster lock like any other verb
+        return self._call(cluster, "watch", resource, namespace, selector,
+                          since_rv=since_rv)
 
     # --------------------------------------------------------- inventory
 
     @property
     def resource_version(self) -> int:
-        body = self._root._request("GET", "/version")
-        return int(body.get("resourceVersion", "0"))
+        client, lock = self._entry(WILDCARD)
+        with lock:
+            body = client._request("GET", "/version")
+        if "resourceVersion" not in body:
+            # an authz'd backend withholds the RV from tokens lacking the
+            # server-global read — returning 0 here would poison watch
+            # bookmarks with a rewind-to-zero, so fail loudly instead
+            raise RuntimeError(
+                "storage backend withheld resourceVersion from /version — "
+                "the --store-token needs the server-global (wildcard get "
+                "debug) read that /clusters and /debug carry")
+        return int(body["resourceVersion"])
 
     def resources(self) -> list[str]:
-        return self._root.resources()
+        return self._call(WILDCARD, "resources")
 
     def clusters(self) -> list[str]:
-        body = self._root._request("GET", "/clusters")
+        client, lock = self._entry(WILDCARD)
+        with lock:
+            body = client._request("GET", "/clusters")
         return list(body.get("clusters", []))
 
     def __len__(self) -> int:
@@ -153,5 +194,8 @@ class RemoteStore:
         """No-op: durability belongs to the backend's store."""
 
     def close(self) -> None:
-        for c in self._scoped.values():
-            c.close()
+        with self._map_lock:
+            entries = list(self._scoped.values())
+        for client, lock in entries:
+            with lock:
+                client.close()

@@ -39,7 +39,12 @@ def _cm(name, v, labeled=True):
             "data": {"v": str(v)}}
 
 
-async def _run_backend(backend: str, seed: int, mesh=None):
+async def _run_backend(backend: str, seed: int, mesh=None, datafn=None,
+                       disrupt=None):
+    """``datafn(rng, step) -> data dict`` shapes update payloads (the
+    schema-evolution family grows the field vocabulary through it);
+    ``disrupt(kcp, syncer)`` fires once mid-sequence (the
+    compaction/watch-drop family)."""
     rng = random.Random(seed)
     kcp, phys = LogicalStore(), LogicalStore()
     up, down = Client(kcp, "t"), Client(phys, "p")
@@ -47,15 +52,20 @@ async def _run_backend(backend: str, seed: int, mesh=None):
                                 backend=backend, resync_period=1.5,
                                 mesh=mesh)
     for step in range(OPS):
+        if disrupt is not None and step == OPS // 2:
+            disrupt(kcp, syncer)
         name = f"cm-{rng.randrange(POOL)}"
         op = rng.random()
         try:
             if op < 0.30:
-                up.create("configmaps", _cm(name, step,
-                                            labeled=rng.random() < 0.85))
+                o = _cm(name, step, labeled=rng.random() < 0.85)
+                if datafn is not None:
+                    o["data"] = datafn(rng, step)
+                up.create("configmaps", o)
             elif op < 0.55:
                 o = up.get("configmaps", name, "default")
-                o["data"] = {"v": str(step)}
+                o["data"] = (datafn(rng, step) if datafn is not None
+                             else {"v": str(step)})
                 up.update("configmaps", o)
             elif op < 0.70:
                 up.delete("configmaps", name, "default")
@@ -100,6 +110,14 @@ async def _run_backend(backend: str, seed: int, mesh=None):
         # positive control: a mesh-plumbing regression would otherwise
         # make sharded == flat pass vacuously on two unsharded runs
         assert syncer.engines[0]._section.bucket.mesh is mesh
+    if datafn is not None:
+        # positive control for the schema-evolution family: the growing
+        # field vocabulary must actually have overflowed the 64-slot
+        # encoder (bucket regrow + re-register), or the scenario silently
+        # degenerated into the plain-churn fuzz
+        assert syncer.engines[0].enc.capacity > 64, (
+            f"vocabulary never outgrew the bucket "
+            f"(capacity={syncer.engines[0].enc.capacity})")
     assert await _wait_until(converged, 20), (
         f"{backend} seed={seed} did not converge")
     state = sorted(
@@ -132,6 +150,124 @@ def test_randomized_churn_differential_sharded():
         sharded = await _run_backend("tpu", 11, mesh=mesh)
         flat = await _run_backend("tpu", 11)
         assert sharded == flat
+
+    asyncio.run(main())
+
+
+@pytest.mark.parametrize("seed", [5, 23])
+def test_schema_evolution_differential(seed):
+    """Mid-sync vocabulary growth: updates keep introducing NEW field
+    names, so the shared bucket overflows its 64-slot encoder, regrows,
+    and re-registers while churn continues — rows migrate to a fresh
+    bucket with live events in flight. Both backends must still converge
+    identically (the round-4 MASK_STAMP bug lived exactly in this
+    re-registration seam)."""
+    def wide(rng, step):
+        data = {"v": str(step)}
+        for _ in range(rng.randrange(2, 6)):
+            data[f"f{rng.randrange(150)}"] = str(step)
+        return data
+
+    async def main():
+        tpu_state = await _run_backend("tpu", seed, datafn=wide)
+        host_state = await _run_backend("host", seed, datafn=wide)
+        assert tpu_state == host_state
+
+    asyncio.run(main())
+
+
+@pytest.mark.parametrize("seed", [13, 29])
+def test_compaction_watch_drop_differential(seed):
+    """Mid-sequence, the upstream store compacts away its retained watch
+    history AND both informer streams break — the reflector loop must
+    re-list (resume-by-RV is impossible past compaction) and the engines
+    must heal to the exact converged state, on both backends."""
+    def disrupt(kcp, syncer):
+        kcp._history.clear()  # snapshot-compaction analog
+        for e in syncer.engines:
+            for inf in (e.up_informer, e.down_informer):
+                if inf._watch is not None:
+                    inf._watch.close()  # stream drop -> relist + rewatch
+
+    async def main():
+        tpu_state = await _run_backend("tpu", seed, disrupt=disrupt)
+        host_state = await _run_backend("host", seed, disrupt=disrupt)
+        assert tpu_state == host_state
+
+    asyncio.run(main())
+
+
+def test_engine_register_retire_races():
+    """A second syncer (placement owner) randomly starts and stops while
+    the first keeps serving: its sections register into and retire from
+    the SAME shared fused bucket mid-churn. Retired rows must neither
+    leak decisions nor corrupt the survivor's lanes, and the final
+    placement must be exact for both clusters."""
+
+    async def main():
+        rng = random.Random(17)
+        kcp, phys1, phys2 = LogicalStore(), LogicalStore(), LogicalStore()
+        up = Client(kcp, "t")
+        down1, down2 = Client(phys1, "p1"), Client(phys2, "p2")
+        s1 = await start_syncer(up, down1, ["configmaps"], "c1",
+                                resync_period=1.5)
+        s2 = None
+        for step in range(90):
+            if rng.random() < 0.08:
+                if s2 is None:
+                    s2 = await start_syncer(up, down2, ["configmaps"], "c2",
+                                            resync_period=1.5)
+                else:
+                    await s2.stop()
+                    s2 = None
+            name = f"cm-{rng.randrange(12)}"
+            op = rng.random()
+            try:
+                if op < 0.35:
+                    cluster = "c1" if rng.random() < 0.5 else "c2"
+                    o = _cm(name, step, labeled=False)
+                    o["metadata"]["labels"] = {CLUSTER_LABEL: cluster}
+                    up.create("configmaps", o)
+                elif op < 0.6:
+                    o = up.get("configmaps", name, "default")
+                    o["data"] = {"v": str(step)}
+                    up.update("configmaps", o)
+                elif op < 0.75:
+                    up.delete("configmaps", name, "default")
+                else:
+                    o = up.get("configmaps", name, "default")
+                    labels = o["metadata"].get("labels") or {}
+                    cur = labels.get(CLUSTER_LABEL)
+                    labels[CLUSTER_LABEL] = "c2" if cur == "c1" else "c1"
+                    o["metadata"]["labels"] = labels
+                    up.update("configmaps", o)
+            except Exception:
+                pass
+            if step % 8 == 0:
+                await asyncio.sleep(0.01)
+        # end with BOTH syncers serving so both placements can settle
+        if s2 is None:
+            s2 = await start_syncer(up, down2, ["configmaps"], "c2",
+                                    resync_period=1.5)
+
+        def placed():
+            want = {"c1": {}, "c2": {}}
+            for o in up.list("configmaps")[0]:
+                cl = (o["metadata"].get("labels") or {}).get(CLUSTER_LABEL)
+                if cl in want:
+                    want[cl][o["metadata"]["name"]] = o["data"]
+            got1 = {o["metadata"]["name"]: o["data"]
+                    for o in down1.list("configmaps")[0]}
+            got2 = {o["metadata"]["name"]: o["data"]
+                    for o in down2.list("configmaps")[0]}
+            return want["c1"] == got1 and want["c2"] == got2
+
+        try:
+            assert await _wait_until(placed, 25), (
+                "placement did not converge after register/retire races")
+        finally:
+            await s1.stop()
+            await s2.stop()
 
     asyncio.run(main())
 
