@@ -85,6 +85,11 @@ class RestHandler:
         return await asyncio.get_running_loop().run_in_executor(
             self._store_pool, functools.partial(fn, *args, **kwargs))
 
+    def close(self) -> None:
+        """Release handler resources (the store-I/O pool's threads)."""
+        if self._store_pool is not None:
+            self._store_pool.shutdown(wait=False, cancel_futures=True)
+
     async def _server_scope_allowed(self, req) -> bool:
         """True when the caller may read server-global (cross-tenant)
         state — /debug, /clusters, the RV in /version share this one
@@ -126,8 +131,16 @@ class RestHandler:
             # apiserver.
             body = dict(self.version_info)
             if await self._server_scope_allowed(req):
-                body["resourceVersion"] = str(
-                    await self._st(lambda: self.store.resource_version))
+                try:
+                    body["resourceVersion"] = str(
+                        await self._st(lambda: self.store.resource_version))
+                except RuntimeError:
+                    # remote-store frontend whose backend withholds the RV
+                    # (insufficient --store-token): the version fields
+                    # stay public and the RV is simply omitted, exactly
+                    # as the backend itself responds to that token. Peer
+                    # RV probes still fail loudly (missing key).
+                    pass
             return Response.of_json(body)
         if head == "clusters" and len(segs) == 1:
             # index of live logical clusters (the store's tenant set) —

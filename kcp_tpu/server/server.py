@@ -258,6 +258,7 @@ class Server:
                 set_serving_mesh(None)
             self._installed_mesh = None
         await self.http.stop()
+        self.handler.close()
         if self.config.durable:
             self.store.snapshot()
         self.store.close()

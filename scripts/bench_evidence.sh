@@ -1,0 +1,42 @@
+#!/usr/bin/env bash
+# Round-evidence battery on the real chip — run when the TPU tunnel is
+# healthy and NOTHING else is touching it (docs/operations.md: one
+# process on the tunnel at a time; everything here runs sequentially).
+#
+# Produces, under $OUT (default /tmp/bench_evidence):
+#   p99_run_{1..5}.json    five consecutive default runs (multi-run p99
+#                          table — the variance-aware convergence claim)
+#   suite.json             kernel-lane suite (schema lane re-measure
+#                          after the native tokenizer rebuild)
+#   churn_{256,768,2048,4096}.json  event-rate headroom curve
+#   rows1m.json            1M-resident-row scale run with the stall
+#                          diagnostics (full_uploads/gap per segment)
+# Each file is ONE bench JSON line; stderr logs sit next to each.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+OUT="${OUT:-/tmp/bench_evidence}"
+mkdir -p "$OUT"
+
+run() { # run <name> [env k=v...] [-- bench args...]
+    local name="$1"; shift
+    local envs=() args=() in_args=0
+    for tok in "$@"; do
+        if [[ "$tok" == "--" ]]; then in_args=1
+        elif [[ "$in_args" == 1 ]]; then args+=("$tok")
+        else envs+=("$tok"); fi
+    done
+    echo "== $name ($(date +%H:%M:%S))"
+    env "${envs[@]}" python bench.py "${args[@]}" \
+        > "$OUT/$name.json" 2> "$OUT/$name.stderr.log"
+    tail -c 400 "$OUT/$name.json"; echo
+}
+
+for i in 1 2 3 4 5; do
+    run "p99_run_$i"
+done
+run suite -- --suite
+for c in 256 768 2048 4096; do
+    run "churn_$c" KCP_BENCH_CHURN="$c"
+done
+run rows1m KCP_BENCH_ROWS=1048576
+echo "evidence battery complete: $OUT"
