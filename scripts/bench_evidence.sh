@@ -26,10 +26,14 @@ run() { # run <name> [env k=v...] [-- bench args...]
         else envs+=("$tok"); fi
     done
     echo "== $name ($(date +%H:%M:%S))"
-    env "${envs[@]}" python bench.py "${args[@]}" \
-        > "$OUT/$name.json" 2> "$OUT/$name.stderr.log"
+    if ! env "${envs[@]}" python bench.py "${args[@]}" \
+            > "$OUT/$name.json" 2> "$OUT/$name.stderr.log"; then
+        echo "FAILED: $name (see $OUT/$name.stderr.log)"
+        FAILURES+=("$name")
+    fi
     tail -c 400 "$OUT/$name.json"; echo
 }
+FAILURES=()
 
 for i in 1 2 3 4 5; do
     run "p99_run_$i"
@@ -39,4 +43,8 @@ for c in 256 768 2048 4096; do
     run "churn_$c" KCP_BENCH_CHURN="$c"
 done
 run rows1m KCP_BENCH_ROWS=1048576
+if ((${#FAILURES[@]})); then
+    echo "evidence battery INCOMPLETE: ${FAILURES[*]} failed ($OUT)"
+    exit 1
+fi
 echo "evidence battery complete: $OUT"

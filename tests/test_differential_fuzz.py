@@ -90,14 +90,29 @@ async def _run_backend(backend: str, seed: int, mesh=None, datafn=None,
         if step % 8 == 0:
             await asyncio.sleep(0.01)
 
-    def converged():
+    def _pairs():
         up_items = {o["metadata"]["name"]: o for o in up.list("configmaps")[0]
                     if (o["metadata"].get("labels") or {})
                     .get(CLUSTER_LABEL) == "c1"}
         down_items = {o["metadata"]["name"]: o
                       for o in down.list("configmaps")[0]}
         if set(up_items) != set(down_items):
+            return None
+        return up_items, down_items
+
+    def spec_converged():
+        pairs = _pairs()
+        if pairs is None:
             return False
+        up_items, down_items = pairs
+        return all(down_items[n]["data"] == u["data"]
+                   for n, u in up_items.items())
+
+    def converged():
+        pairs = _pairs()
+        if pairs is None:
+            return False
+        up_items, down_items = pairs
         for name, u in up_items.items():
             d = down_items[name]
             if u["data"] != d["data"]:
@@ -118,6 +133,26 @@ async def _run_backend(backend: str, seed: int, mesh=None, datafn=None,
         assert syncer.engines[0].enc.capacity > 64, (
             f"vocabulary never outgrew the bucket "
             f"(capacity={syncer.engines[0].enc.capacity})")
+    # the mid-run status ops race the engine (a down.get can hit a
+    # not-yet-downsynced object), so WHICH of them landed is timing- and
+    # backend-speed-dependent — legitimate chaos, but not a deterministic
+    # final state. Settle specs first, then write one deterministic
+    # status round over every surviving downstream object and require it
+    # to upsync: a stronger proof than the racing subset (every surviving
+    # row must upsync — the MASK_STAMP class of bug cannot hide), and the
+    # cross-backend state comparison becomes exact.
+    assert await _wait_until(spec_converged, 20), (
+        f"{backend} seed={seed} specs did not converge")
+    for o in down.list("configmaps")[0]:
+        name = o["metadata"]["name"]
+        for _ in range(5):  # re-read on conflict with an in-flight apply
+            try:
+                final = dict(down.get("configmaps", name, "default"))
+                final["status"] = {"observed": "final"}
+                down.update_status("configmaps", final)
+                break
+            except Exception:  # noqa: BLE001 — conflict / racing delete
+                await asyncio.sleep(0.02)
     assert await _wait_until(converged, 20), (
         f"{backend} seed={seed} did not converge")
     state = sorted(
@@ -154,7 +189,7 @@ def test_randomized_churn_differential_sharded():
     asyncio.run(main())
 
 
-@pytest.mark.parametrize("seed", [5, 23])
+@pytest.mark.parametrize("seed", [5, 23, 41])
 def test_schema_evolution_differential(seed):
     """Mid-sync vocabulary growth: updates keep introducing NEW field
     names, so the shared bucket overflows its 64-slot encoder, regrows,
@@ -176,7 +211,7 @@ def test_schema_evolution_differential(seed):
     asyncio.run(main())
 
 
-@pytest.mark.parametrize("seed", [13, 29])
+@pytest.mark.parametrize("seed", [13, 29, 37])
 def test_compaction_watch_drop_differential(seed):
     """Mid-sequence, the upstream store compacts away its retained watch
     history AND both informer streams break — the reflector loop must

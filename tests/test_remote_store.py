@@ -140,6 +140,55 @@ def test_expired_watch_window_surfaces_through_frontend(pair):
     asyncio.run(main())
 
 
+def test_syncer_through_frontend(pair):
+    """Full control-plane integration: a syncer whose UPSTREAM client is
+    the frontend (informers ride the frontend's relayed watch streams;
+    writes pass through to the backend's store) downsyncs to a local
+    physical store and upsyncs status back — the deepest remote-store
+    path a controller exercises."""
+    from kcp_tpu.client import Client
+    from kcp_tpu.store import LogicalStore
+    from kcp_tpu.syncer import start_syncer
+    from kcp_tpu.syncer.engine import CLUSTER_LABEL
+
+    backend, frontend = pair
+
+    async def main():
+        up = RestClient(frontend.address, ca_data=frontend.ca_pem,
+                        cluster="tenant-s")
+        phys = Client(LogicalStore(), "p")
+        syncer = await start_syncer(up, phys, ["configmaps"], "east",
+                                    backend="tpu", resync_period=1.5)
+        try:
+            # create through the BACKEND: the event must reach the
+            # syncer's informer via backend -> frontend relay -> syncer
+            bc = RestClient(backend.address, ca_data=backend.ca_pem,
+                            cluster="tenant-s")
+            obj = cm("relayed", "tenant-s", {"k": "v"})
+            obj["metadata"]["labels"] = {CLUSTER_LABEL: "east"}
+            bc.create("configmaps", obj)
+
+            from helpers import wait_until as settled
+
+            assert await settled(lambda: any(
+                o["metadata"]["name"] == "relayed"
+                for o in phys.list("configmaps")[0]), 15.0), (
+                "downsync never landed")
+
+            # status upsync back through frontend -> backend
+            d = phys.get("configmaps", "relayed", "default")
+            d["status"] = {"phase": "Synced"}
+            phys.update_status("configmaps", d)
+            assert await settled(lambda: (
+                bc.get("configmaps", "relayed", "default")
+                .get("status", {}).get("phase") == "Synced"), 15.0), (
+                "status upsync never landed")
+        finally:
+            await syncer.stop()
+
+    asyncio.run(main())
+
+
 def test_remote_store_inventory_probes(pair):
     backend, frontend = pair
     store = frontend.server.store
