@@ -68,7 +68,7 @@ def _admit(authz: Authorizer, user: str, resource_short: str,
 
 def test_admitted_writes_never_mint_permissions():
     total_admitted = 0
-    for seed in range(6):
+    for seed in range(12):
         rng = random.Random(seed)
         store = LogicalStore()
         authz = Authorizer(store)

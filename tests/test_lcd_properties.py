@@ -31,7 +31,7 @@ import random
 
 from kcp_tpu.schemacompat import ensure_structural_schema_compatibility as ensure
 
-N_SEEDS = 80
+N_SEEDS = 160
 
 
 def _rand_schema(rng: random.Random, depth: int = 0) -> dict:

@@ -27,7 +27,7 @@ def _drain(watch):
     return watch.drain()
 
 
-@pytest.mark.parametrize("seed", [1, 7, 19])
+@pytest.mark.parametrize("seed", [1, 7, 19, 23, 31])
 def test_resume_delivers_exactly_the_missed_suffix(seed):
     rng = random.Random(seed)
     store = LogicalStore()
