@@ -311,6 +311,16 @@ def main() -> int:
         owner = _BenchOwner(core, B, S)
         bucket = owner.bucket
         bucket.patch_capacity = 8192
+        # pre-warm the acks-lane high-water: the wire's (packed, acks)
+        # shape pair is compiled per capacity, and a mid-measurement
+        # ack_capacity doubling costs one seconds-long recompile — the
+        # prime suspect for r04's 1M-row segment-2 stall (a ~6.8 s
+        # "full-upload-sized" gap with no full_uploads increment). Ack
+        # bursts track the batch-drained event count (CHURN-proportional,
+        # with batching slack) and grow with fleet-scale backlogs, so
+        # fold both into the floor, kept pow2 for sticky shapes.
+        ack_floor = max(8192, B // 64, 2 * CHURN)
+        bucket.ack_capacity = 1 << (ack_floor - 1).bit_length()
         await core.start()
 
         # ---- warmup: first compile + full upload + pipeline fill, with
