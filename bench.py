@@ -623,9 +623,21 @@ def suite() -> int:
 def _fail_json(stage: str, detail: str, attempts: int, for_suite: bool) -> None:
     err = {"stage": stage, "detail": detail[-2000:], "attempts": attempts}
     # a dead tunnel must not erase the round's record: committed
-    # measurements exist independently of this run
+    # measurements exist independently of this run. If a round-long
+    # probe log exists (round 5 ran the bench repeatedly all day waiting
+    # for the tunnel), summarize it so a zero here is self-explanatory.
     committed = ("committed evidence: BENCH_r04_early/tuned/pallas/suite/1m"
                  ".json + BASELINE.md 'Measured results'")
+    try:
+        with open("/tmp/probe_loop.log", encoding="utf-8",
+                  errors="replace") as f:
+            probes = [ln.strip() for ln in f if ln.strip()]
+        if probes:
+            # bounded like detail[-2000:]: this is one JSON line
+            committed += (" | tunnel probes this round: "
+                          + "; ".join(probes[-8:])[:800])
+    except (OSError, UnicodeError):
+        pass
     if for_suite:
         print(json.dumps({"suite": [], "error": err, "note": committed}))
     else:
