@@ -17,6 +17,8 @@ control plane at :6443) with the fork's logical-cluster semantics:
 
 from __future__ import annotations
 
+import asyncio
+import functools
 import json
 import os
 
@@ -68,8 +70,9 @@ class RestHandler:
         # stream, and health probe). A small pool bounds concurrency;
         # in-process stores stay inline (in-memory, and the race guard
         # expects loop-thread affinity).
+        self._remote = getattr(store, "is_remote", False)
         self._store_pool = None
-        if getattr(store, "is_remote", False):
+        if self._remote:
             from concurrent.futures import ThreadPoolExecutor
 
             self._store_pool = ThreadPoolExecutor(
@@ -79,11 +82,14 @@ class RestHandler:
         """Run a store call; offloaded to the I/O pool for remote stores."""
         if self._store_pool is None:
             return fn(*args, **kwargs)
-        import asyncio
-        import functools
-
         return await asyncio.get_running_loop().run_in_executor(
             self._store_pool, functools.partial(fn, *args, **kwargs))
+
+    def _forbidden(self, req, action: str) -> Response:
+        user = self.authenticator.user_for(req.headers)
+        return Response.of_json(
+            _status_body(403, "Forbidden", f'user "{user}" cannot {action}'),
+            403)
 
     def close(self) -> None:
         """Release handler resources (the store-I/O pool's threads)."""
@@ -98,8 +104,6 @@ class RestHandler:
         Authorizer reads roles/bindings through the remote store."""
         if self.authorizer is None:
             return True
-        from ..store.store import WILDCARD
-
         user = self.authenticator.user_for(req.headers)
         return await self._st(
             self.authorizer.allowed, user, WILDCARD, "get", "", "debug")
@@ -148,10 +152,7 @@ class RestHandler:
             # The tenant list is exactly what per-tenant RBAC is meant to
             # hide, so it is gated like /debug (server-global read).
             if not await self._server_scope_allowed(req):
-                user = self.authenticator.user_for(req.headers)
-                return Response.of_json(
-                    _status_body(403, "Forbidden",
-                                 f'user "{user}" cannot list clusters'), 403)
+                return self._forbidden(req, "list clusters")
             return Response.of_json(
                 {"clusters": await self._st(self.store.clusters)})
         if head == "metrics":
@@ -166,11 +167,7 @@ class RestHandler:
             # with authz on it is gated like cross-tenant reads (root
             # cluster-admin), matching pprof-on-the-secure-port semantics.
             if not await self._server_scope_allowed(req):
-                user = self.authenticator.user_for(req.headers)
-                return Response.of_json(
-                    _status_body(403, "Forbidden",
-                                 f'user "{user}" cannot read /debug/profile'),
-                    403)
+                return self._forbidden(req, "read /debug/profile")
             from ..utils.trace import sample_profile
 
             try:
@@ -182,11 +179,7 @@ class RestHandler:
             # on-demand XLA/device trace (xprof): the device-side half of
             # the profiling story. Same gate as /debug/profile.
             if not await self._server_scope_allowed(req):
-                user = self.authenticator.user_for(req.headers)
-                return Response.of_json(
-                    _status_body(403, "Forbidden",
-                                 f'user "{user}" cannot trace'), 403)
-            import asyncio as _asyncio
+                return self._forbidden(req, "trace")
             import tempfile
 
             from ..utils.trace import device_trace
@@ -198,7 +191,7 @@ class RestHandler:
             log_dir = req.param("dir") or tempfile.mkdtemp(
                 prefix="kcp-device-trace-")
             with device_trace(log_dir) as started:
-                await _asyncio.sleep(seconds)
+                await asyncio.sleep(seconds)
             return Response.of_json({
                 "dir": log_dir, "seconds": seconds,
                 "started": bool(started),
@@ -443,7 +436,7 @@ class RestHandler:
         """Wildcard single-object reads scan tenants for the unique owner."""
         if cluster != WILDCARD:
             return cluster
-        if self._store_pool is not None:
+        if self._remote:
             # storage frontend: the backend's own handler resolves '*'
             # (this same scan, against its in-memory index) — forwarding
             # the wildcard costs one round trip instead of tenants+1
@@ -494,8 +487,6 @@ class RestHandler:
         bookmark_every = 5.0
 
         async def produce(stream: StreamResponse) -> None:
-            import asyncio
-
             try:
                 watch = await self._st(
                     self.store.watch, res, cluster, namespace, selector, since_rv)
@@ -542,7 +533,7 @@ class RestHandler:
                             # has DELIVERED (last_rv) — a fresher store
                             # RV would let a resuming client skip that
                             # in-flight event forever.
-                            if self._store_pool is not None:
+                            if self._remote:
                                 rv_now = getattr(watch, "last_rv", 0)
                                 if not rv_now:
                                     continue  # nothing delivered yet
