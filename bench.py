@@ -628,16 +628,17 @@ def _fail_json(stage: str, detail: str, attempts: int, for_suite: bool) -> None:
     # for the tunnel), summarize it so a zero here is self-explanatory.
     committed = ("committed evidence: BENCH_r04_early/tuned/pallas/suite/1m"
                  ".json + BASELINE.md 'Measured results'")
-    try:
-        with open("/tmp/probe_loop.log", encoding="utf-8",
-                  errors="replace") as f:
-            probes = [ln.strip() for ln in f if ln.strip()]
-        if probes:
-            # bounded like detail[-2000:]: this is one JSON line
-            committed += (" | tunnel probes this round: "
-                          + "; ".join(probes[-8:])[:800])
-    except (OSError, UnicodeError):
-        pass
+    probes: list[str] = []
+    for log_path in ("/tmp/probe_loop.log", "/tmp/probe_loop2.log"):
+        try:
+            with open(log_path, encoding="utf-8", errors="replace") as f:
+                probes += [ln.strip() for ln in f if ln.strip()]
+        except (OSError, UnicodeError):
+            pass
+    if probes:
+        # bounded like detail[-2000:]: this is one JSON line
+        committed += (" | tunnel probes this round: "
+                      + "; ".join(probes[-10:])[:800])
     if for_suite:
         print(json.dumps({"suite": [], "error": err, "note": committed}))
     else:
