@@ -162,7 +162,7 @@ async def _run_backend(backend: str, seed: int, mesh=None, datafn=None,
     return state
 
 
-@pytest.mark.parametrize("seed", [3, 11, 42])
+@pytest.mark.parametrize("seed", [3, 11, 42, 57, 63])
 def test_randomized_churn_differential(seed):
     async def main():
         tpu_state = await _run_backend("tpu", seed)
