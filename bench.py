@@ -81,9 +81,11 @@ S = 64
 # wire, and the applier pool are O(events) — this knob finds where they
 # take over.
 CHURN = int(os.environ.get("KCP_BENCH_CHURN", "768"))
-WARMUP_TICKS = 24
-SEGMENT_S = 8.0
-SEGMENTS = 3
+# measurement-shape knobs, env-overridable so the CI smoke (scripts/
+# ci.sh: tiny rows, one short segment, CPU) can drive the same harness
+WARMUP_TICKS = int(os.environ.get("KCP_BENCH_WARMUP", "24"))
+SEGMENT_S = float(os.environ.get("KCP_BENCH_SEGMENT_S", "8.0"))
+SEGMENTS = int(os.environ.get("KCP_BENCH_SEGMENTS", "3"))
 STALL_S = 45.0  # no tick progress for this long => wedged device, abort
 
 # orchestrator budget: 3 attempts x 240s + 2 short backoffs ~= 13.5 min,
@@ -275,6 +277,195 @@ class Deadman:
         self._timer.start()
 
 
+def pipeline_arg(argv: list[str]) -> str | None:
+    """--pipeline {serial,double}: run the serial-vs-pipelined tick A/B
+    (both modes in one invocation); the named mode is the headline."""
+    if "--pipeline" not in argv:
+        return None
+    i = argv.index("--pipeline")
+    if i + 1 >= len(argv) or argv[i + 1] not in ("serial", "double"):
+        print("--pipeline requires 'serial' or 'double'", file=sys.stderr)
+        raise SystemExit(2)
+    return argv[i + 1]
+
+
+async def _measure(best: dict, pipeline: str | None = None,
+                   ab: bool = False) -> dict:
+    """One warmup + segments measurement pass over a fresh core.
+
+    ``pipeline`` selects the core's tick-pipelining mode (None = the
+    serving default, "double"); ``ab=True`` marks every emitted evidence
+    line provisional (the combined A/B line is the headline) and
+    prefixes stages with the mode name."""
+    from kcp_tpu.syncer.core import FusedCore
+
+    tag = f"{pipeline}-" if ab and pipeline else ""
+    core = FusedCore(batch_window=0.0005,
+                     use_pallas=True if "--pallas" in sys.argv else None,
+                     pipeline=pipeline)
+    owner = _BenchOwner(core, B, S)
+    bucket = owner.bucket
+    bucket.patch_capacity = 8192
+    # pre-warm the acks-lane high-water: the wire's (packed, acks)
+    # shape pair is compiled per capacity, and a mid-measurement
+    # ack_capacity doubling costs one seconds-long recompile — the
+    # prime suspect for r04's 1M-row segment-2 stall (a ~6.8 s
+    # "full-upload-sized" gap with no full_uploads increment). Ack
+    # bursts track the batch-drained event count (CHURN-proportional,
+    # with batching slack) and grow with fleet-scale backlogs, so
+    # fold both into the floor, kept pow2 for sticky shapes.
+    ack_floor = max(8192, B // 64, 2 * CHURN)
+    bucket.ack_capacity = 1 << (ack_floor - 1).bit_length()
+    await core.start()
+
+    # ---- warmup: first compile + full upload + pipeline fill, with
+    # its own stall guard (r01's failure mode: init hangs forever)
+    t0 = time.perf_counter()
+    owner.emit_churn(CHURN)
+    last_tick, last_progress = -1, t0
+    while bucket.stats["ticks"] < WARMUP_TICKS:
+        owner.emit_churn(CHURN)
+        await asyncio.sleep(0.002)
+        now = time.perf_counter()
+        t = bucket.stats["ticks"]
+        if t != last_tick:
+            last_tick, last_progress = t, now
+        elif now - last_progress > STALL_S:
+            emit(result_json(
+                0, provisional=True, stage=f"{tag}warmup-stall",
+                note=f"tick counter stuck at {t} for {STALL_S:.0f}s"))
+            os._exit(0)
+    warmup_s = time.perf_counter() - t0
+    warmup_rate = B * WARMUP_TICKS / warmup_s
+    print(f"{tag}warmup: {WARMUP_TICKS} ticks in {warmup_s:.1f}s "
+          f"({warmup_s / WARMUP_TICKS * 1e3:.0f} ms/tick incl. compile)",
+          file=sys.stderr)
+    # provisional evidence line: includes compile time, so it
+    # UNDERSTATES steady state — but it survives anything after it
+    best["result"] = result_json(
+        warmup_rate, provisional=True, stage=f"{tag}warmup",
+        note="rate includes XLA compile; steady-state segments follow")
+    emit(best["result"])
+
+    # ---- measurement: short segments, best-so-far after each
+    owner.lat_ms.clear()
+    owner.lat_strict_ms.clear()
+    owner._strict_pending.clear()
+    owner.patch_rows = 0
+    seg_rates: list[float] = []
+
+    async def churn_pump(budget_s: float) -> tuple[bool, float]:
+        """One churn batch per core tick; (stalled, max tick gap s).
+
+        The time budget only ends the segment once at least one tick
+        has landed — a zero-tick segment keeps waiting so a wedged
+        device hits the STALL_S check instead of "completing" with
+        nothing measured (the r03 hang ran 20 minutes dark this way).
+        The max inter-tick gap is the stall diagnostic: a segment
+        whose rate collapses but whose gap stays at ~tick time lost
+        throughput smoothly, while a multi-second gap is one discrete
+        stall (e.g. an unintended full re-upload or a recompile).
+        """
+        seg_start = time.perf_counter()
+        last, progress = bucket.stats["ticks"], seg_start
+        ticked = False
+        gap_max = 0.0
+        # prime the loop: a fully-drained queue (fast ticks converge
+        # everything between segments) would otherwise deadlock —
+        # churn waits for a tick, the tick waits for events
+        owner.emit_churn(CHURN)
+        while True:
+            now = time.perf_counter()
+            if now - seg_start >= budget_s and ticked:
+                return False, gap_max
+            t = bucket.stats["ticks"]
+            if t != last:
+                gap_max = max(gap_max, now - progress)
+                last, progress, ticked = t, now, True
+                owner.emit_churn(CHURN)
+            elif now - progress > STALL_S:
+                return True, max(gap_max, now - progress)
+            await asyncio.sleep(0.0002)
+
+    stalled = False
+    result: dict = best.get("result") or {}
+    for seg in range(SEGMENTS):
+        tick0 = bucket.stats["ticks"]
+        fu0 = bucket.stats["full_uploads"]
+        ov0 = bucket.stats["overflows"]
+        t0 = time.perf_counter()
+        stalled, gap_max = await churn_pump(SEGMENT_S)
+        dt = time.perf_counter() - t0
+        ticks = bucket.stats["ticks"] - tick0
+        if ticks > 0:
+            seg_rates.append(B * ticks / dt)
+        lat = np.asarray(owner.lat_ms)
+        pcts = np.percentile(lat, [50, 99]) if lat.size else (None, None)
+        strict = np.asarray(owner.lat_strict_ms)
+        strict_p99 = float(np.percentile(strict, 99)) if strict.size else None
+        value = float(np.median(seg_rates)) if seg_rates else warmup_rate
+        diags = {
+            "full_uploads_delta": bucket.stats["full_uploads"] - fu0,
+            "overflows_delta": bucket.stats["overflows"] - ov0,
+            "max_tick_gap_ms": round(gap_max * 1e3, 1),
+        }
+        if pipeline is not None:
+            diags["pipeline"] = pipeline
+        print(f"{tag}segment {seg + 1}/{SEGMENTS}: {ticks} ticks in {dt:.1f}s "
+              f"({dt / max(ticks, 1) * 1e3:.1f} ms/tick, "
+              f"max gap {gap_max * 1e3:.0f} ms, "
+              f"+{diags['full_uploads_delta']} full uploads)"
+              + (" [STALLED]" if stalled else ""), file=sys.stderr)
+        note = None
+        if stalled:
+            note = ("device stalled mid-measurement; median of completed "
+                    "segments" if seg_rates
+                    else "device stalled before any measured segment; "
+                         "warmup rate (incl. compile)")
+        result = result_json(
+            value, provisional=ab or stalled or seg < SEGMENTS - 1,
+            stage=f"{tag}segment-{seg + 1}", segments=seg_rates,
+            p50_ms=float(pcts[0]) if pcts[0] is not None else None,
+            p99_ms=float(pcts[1]) if pcts[1] is not None else None,
+            strict_p99_ms=strict_p99,
+            diags=diags,
+            note=note)
+        best["result"] = result
+        emit(result)
+        if stalled:
+            break
+
+    meas_ticks = bucket.stats["ticks"] - WARMUP_TICKS
+    print(
+        f"{tag}rows={B} (={TENANTS} tenants) | events/tick~{CHURN}x2 | "
+        f"patches/tick={owner.patch_rows / max(meas_ticks, 1):.0f} | "
+        f"full_uploads={bucket.stats['full_uploads']} | "
+        f"overflows={bucket.stats['overflows']} | "
+        f"acked={bucket.stats['acked']}",
+        file=sys.stderr,
+    )
+    # tick-phase profile (fused_* spans recorded by syncer/core.py):
+    # the "where does tick time go" answer, per tick, in ms
+    from kcp_tpu.utils.trace import REGISTRY
+
+    snap = REGISTRY.snapshot()
+    parts = []
+    for k, v in sorted(snap.items()):
+        if (k.startswith("fused_") and k.endswith("_seconds")
+                and isinstance(v, dict) and v["count"]):
+            parts.append(f"{k[6:-8]}={v['mean'] * 1e3:.1f}ms"
+                         f"(p99 {v['p99'] * 1e3:.1f})")
+    if parts:
+        print(f"{tag}tick phases: " + " ".join(parts), file=sys.stderr)
+    if not stalled:
+        # graceful stop, but never let a wedged drain eat the evidence
+        try:
+            await asyncio.wait_for(core.stop(), timeout=10)
+        except Exception:  # noqa: BLE001 — evidence already emitted
+            pass
+    return result
+
+
 def main() -> int:
     best: dict = {}
     deadman = Deadman(best)
@@ -303,168 +494,33 @@ def main() -> int:
     deadman.arm("measurement")
     print(f"bench device: {dev}", file=sys.stderr)
 
-    async def run() -> None:
-        # --pallas: serve through the fused Pallas decision+fanout pass
-        # (A/B lane for VERDICT r3 item 3; default is the XLA lanes)
-        core = FusedCore(batch_window=0.0005,
-                         use_pallas=True if "--pallas" in sys.argv else None)
-        owner = _BenchOwner(core, B, S)
-        bucket = owner.bucket
-        bucket.patch_capacity = 8192
-        # pre-warm the acks-lane high-water: the wire's (packed, acks)
-        # shape pair is compiled per capacity, and a mid-measurement
-        # ack_capacity doubling costs one seconds-long recompile — the
-        # prime suspect for r04's 1M-row segment-2 stall (a ~6.8 s
-        # "full-upload-sized" gap with no full_uploads increment). Ack
-        # bursts track the batch-drained event count (CHURN-proportional,
-        # with batching slack) and grow with fleet-scale backlogs, so
-        # fold both into the floor, kept pow2 for sticky shapes.
-        ack_floor = max(8192, B // 64, 2 * CHURN)
-        bucket.ack_capacity = 1 << (ack_floor - 1).bit_length()
-        await core.start()
-
-        # ---- warmup: first compile + full upload + pipeline fill, with
-        # its own stall guard (r01's failure mode: init hangs forever)
-        t0 = time.perf_counter()
-        owner.emit_churn(CHURN)
-        last_tick, last_progress = -1, t0
-        while bucket.stats["ticks"] < WARMUP_TICKS:
-            owner.emit_churn(CHURN)
-            await asyncio.sleep(0.002)
-            now = time.perf_counter()
-            t = bucket.stats["ticks"]
-            if t != last_tick:
-                last_tick, last_progress = t, now
-            elif now - last_progress > STALL_S:
-                emit(result_json(
-                    0, provisional=True, stage="warmup-stall",
-                    note=f"tick counter stuck at {t} for {STALL_S:.0f}s"))
-                os._exit(0)
-        warmup_s = time.perf_counter() - t0
-        warmup_rate = B * WARMUP_TICKS / warmup_s
-        print(f"warmup: {WARMUP_TICKS} ticks in {warmup_s:.1f}s "
-              f"({warmup_s / WARMUP_TICKS * 1e3:.0f} ms/tick incl. compile)",
-              file=sys.stderr)
-        # provisional evidence line: includes compile time, so it
-        # UNDERSTATES steady state — but it survives anything after it
-        best["result"] = result_json(
-            warmup_rate, provisional=True, stage="warmup",
-            note="rate includes XLA compile; steady-state segments follow")
-        emit(best["result"])
-
-        # ---- measurement: short segments, best-so-far after each
-        owner.lat_ms.clear()
-        owner.lat_strict_ms.clear()
-        owner._strict_pending.clear()
-        owner.patch_rows = 0
-        seg_rates: list[float] = []
-
-        async def churn_pump(budget_s: float) -> tuple[bool, float]:
-            """One churn batch per core tick; (stalled, max tick gap s).
-
-            The time budget only ends the segment once at least one tick
-            has landed — a zero-tick segment keeps waiting so a wedged
-            device hits the STALL_S check instead of "completing" with
-            nothing measured (the r03 hang ran 20 minutes dark this way).
-            The max inter-tick gap is the stall diagnostic: a segment
-            whose rate collapses but whose gap stays at ~tick time lost
-            throughput smoothly, while a multi-second gap is one discrete
-            stall (e.g. an unintended full re-upload or a recompile).
-            """
-            seg_start = time.perf_counter()
-            last, progress = bucket.stats["ticks"], seg_start
-            ticked = False
-            gap_max = 0.0
-            # prime the loop: a fully-drained queue (fast ticks converge
-            # everything between segments) would otherwise deadlock —
-            # churn waits for a tick, the tick waits for events
-            owner.emit_churn(CHURN)
-            while True:
-                now = time.perf_counter()
-                if now - seg_start >= budget_s and ticked:
-                    return False, gap_max
-                t = bucket.stats["ticks"]
-                if t != last:
-                    gap_max = max(gap_max, now - progress)
-                    last, progress, ticked = t, now, True
-                    owner.emit_churn(CHURN)
-                elif now - progress > STALL_S:
-                    return True, max(gap_max, now - progress)
-                await asyncio.sleep(0.0002)
-
-        stalled = False
-        for seg in range(SEGMENTS):
-            tick0 = bucket.stats["ticks"]
-            fu0 = bucket.stats["full_uploads"]
-            ov0 = bucket.stats["overflows"]
-            t0 = time.perf_counter()
-            stalled, gap_max = await churn_pump(SEGMENT_S)
-            dt = time.perf_counter() - t0
-            ticks = bucket.stats["ticks"] - tick0
-            if ticks > 0:
-                seg_rates.append(B * ticks / dt)
-            lat = np.asarray(owner.lat_ms)
-            pcts = np.percentile(lat, [50, 99]) if lat.size else (None, None)
-            strict = np.asarray(owner.lat_strict_ms)
-            strict_p99 = float(np.percentile(strict, 99)) if strict.size else None
-            value = float(np.median(seg_rates)) if seg_rates else warmup_rate
-            diags = {
-                "full_uploads_delta": bucket.stats["full_uploads"] - fu0,
-                "overflows_delta": bucket.stats["overflows"] - ov0,
-                "max_tick_gap_ms": round(gap_max * 1e3, 1),
-            }
-            print(f"segment {seg + 1}/{SEGMENTS}: {ticks} ticks in {dt:.1f}s "
-                  f"({dt / max(ticks, 1) * 1e3:.1f} ms/tick, "
-                  f"max gap {gap_max * 1e3:.0f} ms, "
-                  f"+{diags['full_uploads_delta']} full uploads)"
-                  + (" [STALLED]" if stalled else ""), file=sys.stderr)
-            note = None
-            if stalled:
-                note = ("device stalled mid-measurement; median of completed "
-                        "segments" if seg_rates
-                        else "device stalled before any measured segment; "
-                             "warmup rate (incl. compile)")
-            best["result"] = result_json(
-                value, provisional=stalled or seg < SEGMENTS - 1,
-                stage=f"segment-{seg + 1}", segments=seg_rates,
-                p50_ms=float(pcts[0]) if pcts[0] is not None else None,
-                p99_ms=float(pcts[1]) if pcts[1] is not None else None,
-                strict_p99_ms=strict_p99,
-                diags=diags,
-                note=note)
-            emit(best["result"])
-            if stalled:
-                break
-
-        meas_ticks = bucket.stats["ticks"] - WARMUP_TICKS
-        print(
-            f"rows={B} (={TENANTS} tenants) | events/tick~{CHURN}x2 | "
-            f"patches/tick={owner.patch_rows / max(meas_ticks, 1):.0f} | "
-            f"full_uploads={bucket.stats['full_uploads']} | "
-            f"overflows={bucket.stats['overflows']} | "
-            f"acked={bucket.stats['acked']}",
-            file=sys.stderr,
-        )
-        # tick-phase profile (fused_* spans recorded by syncer/core.py):
-        # the "where does tick time go" answer, per tick, in ms
-        from kcp_tpu.utils.trace import REGISTRY
-
-        snap = REGISTRY.snapshot()
-        parts = []
-        for k, v in sorted(snap.items()):
-            if k.startswith("fused_") and isinstance(v, dict) and v["count"]:
-                parts.append(f"{k[6:-8]}={v['mean'] * 1e3:.1f}ms"
-                             f"(p99 {v['p99'] * 1e3:.1f})")
-        if parts:
-            print("tick phases: " + " ".join(parts), file=sys.stderr)
-        if not stalled:
-            # graceful stop, but never let a wedged drain eat the evidence
-            try:
-                await asyncio.wait_for(core.stop(), timeout=10)
-            except Exception:  # noqa: BLE001 — evidence already emitted
-                pass
-
-    asyncio.run(run())
+    ab = pipeline_arg(sys.argv)
+    if ab is None:
+        asyncio.run(_measure(best))
+    else:
+        # serial-vs-double A/B in ONE invocation: each mode gets a fresh
+        # loop + core (the jit cache is shared, so the second mode skips
+        # most compile time); the combined line is the headline evidence
+        results: dict[str, dict] = {}
+        for mode in ("serial", "double"):
+            print(f"--- pipeline mode: {mode} ---", file=sys.stderr)
+            results[mode] = asyncio.run(_measure(best, pipeline=mode, ab=True))
+        headline = dict(results[ab])
+        headline.pop("provisional", None)
+        headline["stage"] = "pipeline-ab"
+        headline["pipeline"] = ab
+        headline["pipeline_ab"] = {
+            mode: {k: r[k] for k in ("value", "segment_rates",
+                                     "convergence_p50_ms",
+                                     "convergence_p99_ms")
+                   if k in r}
+            for mode, r in results.items()
+        }
+        if results["serial"].get("value"):
+            headline["pipeline_speedup"] = round(
+                results[ab]["value"] / results["serial"]["value"], 3)
+        best["result"] = headline
+        emit(headline)
     # the last emitted line is the result; exit directly (a wedged device
     # leaves uninterruptible work on the loop — don't hang in teardown)
     sys.stdout.flush()
@@ -628,8 +684,13 @@ def _fail_json(stage: str, detail: str, attempts: int, for_suite: bool) -> None:
     # for the tunnel), summarize it so a zero here is self-explanatory.
     committed = ("committed evidence: BENCH_r04_early/tuned/pallas/suite/1m"
                  ".json + BASELINE.md 'Measured results'")
+    # probe logs are a per-host diagnostic, not a committed artifact:
+    # KCP_BENCH_PROBE_LOGS names them (os.pathsep-separated); unset =
+    # nothing to summarize (the round-5 runs exported it to the scratch
+    # files the retrying probe loop appended to)
     probes: list[str] = []
-    for log_path in ("/tmp/probe_loop.log", "/tmp/probe_loop2.log"):
+    probe_logs = os.environ.get("KCP_BENCH_PROBE_LOGS", "")
+    for log_path in filter(None, probe_logs.split(os.pathsep)):
         try:
             with open(log_path, encoding="utf-8", errors="replace") as f:
                 probes += [ln.strip() for ln in f if ln.strip()]

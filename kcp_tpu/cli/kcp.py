@@ -43,7 +43,16 @@ def build_parser():
                        help="no WAL durability (testing)")
     start.add_argument("--no-install-controllers", action="store_true",
                        help="serve only; controllers run out-of-process "
-                            "(reference: cmd/cluster-controller)")
+                            "(reference: cmd/cluster-controller). This is "
+                            "already the default when --store-server is "
+                            "set (a frontend's controllers would block "
+                            "the serving loop on remote-store calls)")
+    start.add_argument("--force-install-controllers", action="store_true",
+                       help="run in-process controllers even with "
+                            "--store-server, accepting that a slow "
+                            "storage backend can block the serving loop "
+                            "and that no other process may run "
+                            "controllers against the same backend")
     start.add_argument("--auto-publish-apis", action="store_true",
                        help="negotiated APIs publish without manual approval "
                             "(reference: --auto_publish_apis)")
@@ -112,7 +121,14 @@ def config_from_args(args) -> Config:
         listen_host=args.listen_host,
         listen_port=args.listen_port,
         durable=not args.in_memory,
-        install_controllers=not args.no_install_controllers,
+        # tri-state: an explicit --no-install-controllers wins; a forced
+        # install wins over the store-server default; otherwise None lets
+        # the server resolve (False with --store-server, True embedded)
+        install_controllers=(
+            False if args.no_install_controllers
+            else True if args.force_install_controllers
+            else None),
+        force_remote_controllers=args.force_install_controllers,
         auto_publish_apis=args.auto_publish_apis,
         resources_to_sync=[r for r in args.resources_to_sync.split(",") if r],
         syncer_mode=args.syncer_mode,

@@ -372,6 +372,64 @@ def reconcile_step_packed(state: ReconcileState, packed: jax.Array,
     return new_state, jnp.concatenate([hdr, entries, pl_entries])
 
 
+class WireBuffers:
+    """Double-buffered host staging for the packed-delta wire.
+
+    The staging/donation contract of :func:`reconcile_step_packed`: the
+    resident state is donated every tick, but the packed array is NOT —
+    ``jax.device_put`` may still be reading the host buffer after it
+    returns (async dispatch). A single reused staging array would let
+    tick N+1's host-side packing scribble over tick N's in-flight
+    transfer; fresh ``np.zeros`` per tick is safe but pays an allocation
+    + page-fault cost on every tick of the hot loop. Two rotating
+    buffers make reuse safe at pipeline depth 2: ``acquire`` hands out
+    the least-recently-used (packed, acks) pair, first blocking — only
+    if the pipeline ran ahead of the transfer engine — until the device
+    arrays that last consumed that pair are ready.
+    """
+
+    def __init__(self, depth: int = 2):
+        self.depth = depth
+        self._packed: list[np.ndarray | None] = [None] * depth
+        self._acks: list[np.ndarray | None] = [None] * depth
+        # device arrays whose transfer last read each slot's host buffers
+        self._pending: list[tuple | None] = [None] * depth
+        self._i = 0
+        self.reuse_waits = 0  # acquires that had to block on a transfer
+
+    def acquire(self, d: int, width: int,
+                ack_capacity: int) -> tuple[int, np.ndarray, np.ndarray]:
+        """A zeroed ``uint32 [d, width]`` packed buffer plus a -1-filled
+        ``int32 [ack_capacity]`` acks buffer, safe to fill immediately.
+        Returns ``(slot, packed, acks)``; pass ``slot`` to :meth:`commit`
+        with the device arrays produced from these buffers."""
+        i = self._i
+        self._i = (i + 1) % self.depth
+        pending = self._pending[i]
+        if pending is not None:
+            self._pending[i] = None
+            for arr in pending:
+                if not arr.is_ready():
+                    self.reuse_waits += 1
+                    arr.block_until_ready()
+        packed = self._packed[i]
+        if packed is None or packed.shape != (d, width):
+            packed = self._packed[i] = np.zeros((d, width), np.uint32)
+        else:
+            packed.fill(0)
+        acks = self._acks[i]
+        if acks is None or acks.shape != (ack_capacity,):
+            acks = self._acks[i] = np.full(ack_capacity, -1, np.int32)
+        else:
+            acks.fill(-1)
+        return i, packed, acks
+
+    def commit(self, slot: int, *device_arrays) -> None:
+        """Record the device arrays whose host->device transfer reads the
+        slot's buffers; the next acquire of this slot gates on them."""
+        self._pending[slot] = device_arrays
+
+
 def unpack_patches(wire: np.ndarray) -> tuple[np.ndarray, np.ndarray, np.ndarray, bool, np.ndarray]:
     """Host-side: (idx, code, upsync, overflow, stats) from the wire array."""
     count = int(wire[0])

@@ -125,6 +125,15 @@ class BatchController(Controller):
     (item, error) pairs that failed; those are retried under the same
     policy as :class:`Controller`. A single worker loop is enough — the
     parallelism lives inside the batch program, not in the scheduler.
+
+    ``overlap_drain=True`` pipelines the drain stage: the NEXT tick's
+    queue drain (including its ``batch_window`` micro-batching wait)
+    runs concurrently with the CURRENT tick's processing, so a tick that
+    dispatches a device step and applies a previous step's patches never
+    serializes with event accumulation. Safe because drained items sit
+    in the queue's ``_processing`` set until ``complete_many`` — a
+    concurrent drain can never hand out an item the in-flight tick still
+    owns (re-adds park in ``_redo`` exactly as without overlap).
     """
 
     def __init__(
@@ -136,6 +145,7 @@ class BatchController(Controller):
         max_batch: int = 4096,
         batch_window: float = 0.005,
         tenant_of=None,
+        overlap_drain: bool = False,
     ):
         async def _unused(_: Item) -> None:  # pragma: no cover
             raise NotImplementedError
@@ -144,6 +154,7 @@ class BatchController(Controller):
         self.process_batch = process_batch
         self.max_batch = max_batch
         self.batch_window = batch_window
+        self.overlap_drain = overlap_drain
         self.ticks = 0
         self.items_processed = 0
 
@@ -152,12 +163,22 @@ class BatchController(Controller):
         self._workers.append(asyncio.create_task(self._tick_loop()))
 
     async def _tick_loop(self) -> None:
+        next_drain: asyncio.Task | None = None
         while True:
-            batch = await self.queue.drain(self.max_batch, self.batch_window)
+            if next_drain is not None:
+                batch = await next_drain
+                next_drain = None
+            else:
+                batch = await self.queue.drain(self.max_batch, self.batch_window)
             if not batch:
                 if self.queue.shutting_down:
                     return
                 continue
+            if self.overlap_drain and not self.queue.shutting_down:
+                # start draining the next batch NOW: its micro-batch
+                # window elapses while this tick encodes/dispatches
+                next_drain = asyncio.create_task(
+                    self.queue.drain(self.max_batch, self.batch_window))
             self.ticks += 1
             self.items_processed += len(batch)
             try:

@@ -496,6 +496,15 @@ class RestHandler:
                 await stream.send_json({"type": "ERROR",
                                         "object": _status_body(410, "Expired", e.message)})
                 return
+            except errors.ApiError as e:
+                # a remote-store backend can refuse the watch itself
+                # (403 bad --store-token, 404 unknown resource, ...):
+                # relay the mapped Status in-stream instead of silently
+                # dropping the client connection (ADVICE r5)
+                await stream.send_json({
+                    "type": "ERROR",
+                    "object": _status_body(e.code, e.reason, e.message)})
+                return
             loop = asyncio.get_event_loop()
             deadline = loop.time() + timeout if timeout else None
             try:
@@ -515,6 +524,16 @@ class RestHandler:
                         await stream.send_json({
                             "type": "ERROR",
                             "object": _status_body(410, "Expired", e.message)})
+                        return
+                    except errors.ApiError as e:
+                        # any other backend refusal mid-relay (403/404/
+                        # 5xx mapped by the REST client) ends the stream
+                        # with a terminal Status carrying the real code,
+                        # not a silent connection drop (ADVICE r5)
+                        await stream.send_json({
+                            "type": "ERROR",
+                            "object": _status_body(e.code, e.reason,
+                                                   e.message)})
                         return
                     except asyncio.TimeoutError:
                         if deadline is not None and loop.time() >= deadline:

@@ -17,6 +17,7 @@ from __future__ import annotations
 import asyncio
 import http.client
 import json
+import threading
 from urllib.parse import quote, urlsplit
 
 from ..apis.scheme import GVR, ResourceInfo, Scheme, default_scheme
@@ -26,15 +27,9 @@ from ..utils import errors
 from ..utils.routing import resolve_write_cluster
 
 
-def _raise_for_status(code: int, body: bytes) -> None:
-    if code < 400:
-        return
-    try:
-        status = json.loads(body)
-    except (ValueError, UnicodeDecodeError):
-        status = {}
-    message = status.get("message", body.decode("latin-1")[:200])
-    reason = status.get("reason", "")
+def _status_error(code: int, reason: str, message: str) -> errors.ApiError:
+    """Map a Status (code, reason) to the ApiError taxonomy — shared by
+    response handling and in-stream watch ERROR events."""
     by_reason = {
         "NotFound": errors.NotFoundError,
         "AlreadyExists": errors.AlreadyExistsError,
@@ -47,7 +42,25 @@ def _raise_for_status(code: int, body: bytes) -> None:
         cls = {404: errors.NotFoundError, 409: errors.ConflictError,
                422: errors.InvalidError, 400: errors.BadRequestError}.get(
                    code, errors.ApiError)
-    raise cls(message)
+    err = cls(message)
+    if cls is errors.ApiError and code >= 400:
+        # codes without a dedicated class (401/403/...) keep their real
+        # code + reason on the instance so relays don't flatten to 500
+        err.code = code
+        if reason:
+            err.reason = reason
+    return err
+
+
+def _raise_for_status(code: int, body: bytes) -> None:
+    if code < 400:
+        return
+    try:
+        status = json.loads(body)
+    except (ValueError, UnicodeDecodeError):
+        status = {}
+    message = status.get("message", body.decode("latin-1")[:200])
+    raise _status_error(code, status.get("reason", ""), message)
 
 
 class RestWatch:
@@ -126,11 +139,20 @@ class RestWatch:
 
     def _handle_line(self, msg: dict) -> None:
         if msg.get("type") == "ERROR":
-            # 410 Gone — watch window expired. Surface it the way the
-            # in-process Watch does (ConflictError) so consumers know to
-            # re-list instead of treating this as a benign close.
-            self.error = errors.ConflictError(
-                (msg.get("object") or {}).get("message", "watch window expired"))
+            obj = msg.get("object") or {}
+            code = obj.get("code", 410)
+            reason = obj.get("reason", "")
+            message = obj.get("message", "watch window expired")
+            if code == 410 or reason == "Expired":
+                # 410 Gone — watch window expired. Surface it the way
+                # the in-process Watch does (ConflictError) so consumers
+                # know to re-list, not treat this as a benign close.
+                self.error = errors.ConflictError(message)
+            else:
+                # a relayed backend refusal (403 bad store token, 404,
+                # ...): carry the real taxonomy so callers don't relist
+                # forever against a watch that can never be served
+                self.error = _status_error(code, reason, message)
             self._closed = True
             self._events.put_nowait(None)
             return
@@ -246,11 +268,19 @@ class RestClient:
 
             self._ssl = client_context(ca_data, ca_file)
         self._discovered: dict[str, ResourceInfo] = {}
+        # _discovered is SHARED across every scoped() clone (a cheap
+        # process-wide discovery cache), and RemoteStore's per-cluster
+        # store-pool threads refresh it concurrently — guard it with an
+        # explicit lock instead of relying on the GIL making dict ops
+        # atomic (ADVICE r5). The lock is shared by the clones too;
+        # refreshes run under it on the caller's own connection, so
+        # holding it never waits on another client's in-flight verb.
+        self._disc_lock = threading.Lock()
         self._conn: http.client.HTTPConnection | None = None
 
     def scoped(self, cluster: str) -> "RestClient":
         c = RestClient.__new__(RestClient)
-        c.__dict__.update(self.__dict__)
+        c.__dict__.update(self.__dict__)  # _discovered + _disc_lock shared
         c.cluster = cluster
         c._conn = None  # connections are per-instance; ssl ctx is shared
         return c
@@ -307,22 +337,32 @@ class RestClient:
             self._conn = None
 
     def _resolve(self, resource: str) -> ResourceInfo:
-        info = self.scheme.by_resource(resource) or self._discovered.get(resource)
+        info = self.scheme.by_resource(resource)
+        if info is not None:
+            return info
+        with self._disc_lock:
+            info = self._discovered.get(resource)
         if info is not None:
             return info
         self._refresh_discovery()
-        info = self._discovered.get(resource)
+        with self._disc_lock:
+            info = self._discovered.get(resource)
         if info is None:
             raise errors.NotFoundError(f"resource {resource} not served")
         return info
 
     def _refresh_discovery(self) -> None:
-        """Populate the resource→GVR map from /api + /apis discovery."""
+        """Populate the resource→GVR map from /api + /apis discovery.
+
+        The HTTP walk runs unlocked (on this client's own connection);
+        the shared map is swapped in one locked merge so concurrent
+        store-pool refreshes never interleave partial states."""
         gvs: list[tuple[str, str]] = [("", "v1")]
         groups = self._request("GET", "/apis") or {}
         for g in groups.get("groups", []):
             for v in g.get("versions", []):
                 gvs.append((g["name"], v["version"]))
+        found: dict[str, ResourceInfo] = {}
         for group, version in gvs:
             prefix = f"/apis/{group}/{version}" if group else f"/api/{version}"
             try:
@@ -333,11 +373,13 @@ class RestClient:
                 if "/" in r["name"]:
                     continue
                 gvr = GVR(group, version, r["name"])
-                self._discovered[gvr.storage_name] = ResourceInfo(
+                found[gvr.storage_name] = ResourceInfo(
                     gvr=gvr, kind=r["kind"], list_kind=r["kind"] + "List",
                     singular=r.get("singularName") or r["kind"].lower(),
                     namespaced=bool(r.get("namespaced")),
                 )
+        with self._disc_lock:
+            self._discovered.update(found)
 
     def _path(self, resource: str, namespace: str | None, name: str | None = None,
               subresource: str | None = None, cluster: str | None = None,
@@ -432,7 +474,9 @@ class RestClient:
 
     def resources(self) -> list[str]:
         self._refresh_discovery()
-        return sorted(set(self._discovered) |
+        with self._disc_lock:
+            discovered = set(self._discovered)
+        return sorted(discovered |
                       {i.gvr.storage_name for i in self.scheme.all()})
 
     def openapi_v2(self) -> dict | None:

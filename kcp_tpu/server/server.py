@@ -40,7 +40,16 @@ class Config:
     # controllers on exactly one process.
     store_token: str = ""  # bearer token for an authz'd storage backend
     store_ca_file: str | None = None  # CA for a TLS storage backend
-    install_controllers: bool = True  # in-proc controllers (kcp start default)
+    install_controllers: bool | None = None  # in-proc controllers.
+    # None = auto: True for an embedded store (kcp start default), False
+    # when store_server is set — in-process controllers issue BLOCKING
+    # RemoteStore HTTP calls (30 s timeout each) straight on the serving
+    # loop via MultiClusterClient, bypassing the handler's store-I/O
+    # thread pool: a slow backend freezes watches and /healthz. An
+    # explicit True with store_server is a hard error unless
+    # force_remote_controllers acknowledges the hazard.
+    force_remote_controllers: bool = False  # accept loop-blocking remote
+    # controllers (and the controller-fighting risk) with store_server
     auto_publish_apis: bool = False  # --auto_publish_apis flag analog
     resources_to_sync: list[str] = field(default_factory=lambda: ["deployments.apps"])
     syncer_mode: str = "push"  # push | pull | none (controller.go:42-48)
@@ -73,6 +82,12 @@ class Server:
         self.config = config or Config()
         self.scheme = scheme or default_scheme()
         self.registry = registry or PhysicalRegistry()
+        # resolve the install_controllers tri-state once (see Config):
+        # frontends serving someone else's storage default to serve-only
+        self.install_controllers = (
+            self.config.install_controllers
+            if self.config.install_controllers is not None
+            else not self.config.store_server)
         if self.config.store_server:
             # external storage: this process is a stateless frontend; the
             # backend's store owns RVs, conflicts, finalizers, and the WAL
@@ -82,16 +97,28 @@ class Server:
                 # no WAL here, but start() still writes admin.kubeconfig
                 # (and TLS persists pki/) under root_dir
                 os.makedirs(self.config.root_dir, exist_ok=True)
-            if self.config.install_controllers:
-                # legal but usually wrong: controllers on BOTH the
-                # frontend and the backend would fight over the same
-                # shared objects (run them on exactly one process)
+            if self.install_controllers:
+                if not self.config.force_remote_controllers:
+                    # hard error, not a warning (ADVICE r5): in-process
+                    # controllers run their RemoteStore HTTP verbs (30 s
+                    # timeouts) directly on the serving loop — a slow or
+                    # unreachable backend freezes watches and /healthz —
+                    # on top of frontend/backend controllers fighting
+                    # over the shared dataset
+                    raise ValueError(
+                        "install_controllers=True with store_server would "
+                        "run controllers that issue blocking remote-store "
+                        "HTTP calls on the serving loop (and fight any "
+                        "backend-side controllers over the shared "
+                        "dataset); run controllers on the storage backend "
+                        "instead, or set force_remote_controllers=True "
+                        "(--force-install-controllers) if you accept both "
+                        "hazards")
                 log.warning(
-                    "--store-server with in-process controllers: make sure "
-                    "the storage backend (or any other frontend) is NOT "
-                    "also running controllers, or they will fight over the "
-                    "shared dataset; frontends usually take "
-                    "--no-install-controllers")
+                    "--store-server with in-process controllers (forced): "
+                    "a slow storage backend can block the serving loop, "
+                    "and the backend (or any other frontend) must NOT "
+                    "also be running controllers")
             self.store = RemoteStore(self.config.store_server,
                                      token=self.config.store_token,
                                      ca_file=self.config.store_ca_file)
@@ -104,7 +131,7 @@ class Server:
             # controller that releases it will run (install_controllers)
             self.store = LogicalStore(
                 wal_path=wal,
-                namespace_lifecycle=self.config.install_controllers,
+                namespace_lifecycle=self.install_controllers,
             )
         authn = authz = None
         if self.config.authz:
@@ -166,7 +193,7 @@ class Server:
                               os.path.join(self.config.root_dir, "admin.kubeconfig"),
                               token=self.config.admin_token,
                               ca_pem=self.certs.ca_cert_pem if self.certs else None)
-        if self.config.install_controllers:
+        if self.install_controllers:
             await self._install_controllers()
         for hook in self._post_start_hooks:
             await hook(self)

@@ -40,7 +40,6 @@ class ServerThread:
     def _run(self) -> None:
         self._loop = asyncio.new_event_loop()
         asyncio.set_event_loop(self._loop)
-        self.server = Server(self._config, **self._server_kwargs)
 
         async def main():
             await self.server.start()
@@ -50,6 +49,11 @@ class ServerThread:
             await self.server.shutdown()
 
         try:
+            # construct INSIDE the try: a constructor failure (bad
+            # config, missing TLS dependency) must surface through
+            # _startup_error immediately, not leave start() waiting out
+            # its whole timeout on a thread that already died
+            self.server = Server(self._config, **self._server_kwargs)
             self._loop.run_until_complete(main())
         except BaseException as e:  # surfaced to start() — not swallowed
             self._startup_error = e

@@ -61,7 +61,11 @@ class RemoteStore:
         # lock, concurrency comes from different clusters proceeding in
         # parallel, and the LRU map itself is guarded by _map_lock.
         # Bounded so a frontend asked about arbitrarily many tenants
-        # doesn't leak a socket per tenant.
+        # doesn't leak a socket per tenant. The discovery cache the
+        # scoped clients share is the one piece of cross-entry state;
+        # RestClient guards it with its own _disc_lock (no GIL
+        # assumption — see rest.py), so per-entry locks stay strictly
+        # about the connection.
         self._map_lock = threading.Lock()
         self._scoped: "OrderedDict[str, tuple[object, threading.Lock]]" = (
             OrderedDict({WILDCARD: (self._root, threading.Lock())}))

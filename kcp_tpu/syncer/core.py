@@ -19,14 +19,22 @@ Topology:
           │                  vocabularies, so masks are [B, S])
           └── Section        one per engine: a set of rows + callbacks
 
-Tick pipeline (the UPLOAD_LEAD/FETCH_DEPTH structure proven in bench.py):
+Tick pipeline — three explicit stages with a PIPELINE_DEPTH-deep
+in-flight window (pipeline="double", the default; "serial" runs the
+stages back-to-back as the A/B reference):
 
-  drain events -> engines encode touched keys -> bucket stages rows
-    -> pack ONE uint32 delta array, device_put, step (donated), wire out
-    -> wire.copy_to_host_async(); collection happens a tick later (or via
-       the idle flusher) without blocking the loop
-    -> unpack patches, route rows to owning sections, engines' appliers
-       take it from there (also without blocking the tick)
+  drain/pack  — drain events (the NEXT batch drains concurrently with
+                this tick: BatchController overlap_drain), engines
+                encode touched keys, bucket stages rows into one of two
+                rotating pre-allocated wire buffers (WireBuffers — tick
+                N's device_put never races tick N+1's packing)
+  dispatch    — device_put + fused step (donated resident state) +
+                wire.copy_to_host_async(); the host never blocks here
+  fetch/apply — wires beyond the in-flight window (2 ticks old, or any
+                age via the idle flusher) are fetched — blocking ONLY on
+                the compact patch wire, never the donated state — then
+                unpacked and routed to owning sections; engines'
+                appliers take it from there without blocking the tick
 
 Patch overflow: the wire carries at most ``patch_capacity`` actionable
 rows. Because the loop is level-triggered (every tick re-decides every
@@ -54,13 +62,14 @@ from ..models.reconcile_model import (
     MASK_STAMP_BIT,
     PACK_HDR,
     ReconcileState,
+    WireBuffers,
     reconcile_step_packed,
     unpack_patches,
     unpack_placement,
 )
 from ..ops.encode import pad_pow2
 from ..reconciler.controller import BatchController
-from ..utils.trace import REGISTRY
+from ..utils.trace import DEPTH_BUCKETS, REGISTRY
 
 log = logging.getLogger(__name__)
 
@@ -91,7 +100,15 @@ def _phase(name: str, dt: float) -> None:
 MIN_ROWS = 64
 MIN_EVENTS = 64
 MIN_PATCH_CAPACITY = 256
-FETCH_DEPTH = 1  # in-flight ticks before a blocking collect
+# pipelined tick window: in-flight steps per bucket before a blocking
+# collect. Depth 2 is the double-buffered pipeline — while the device
+# executes tick N, the host packs tick N+1 and applies tick N-1 — and
+# matches WireBuffers' two staging slots (a deeper window would reuse a
+# staging buffer while its transfer could still be in flight). "serial"
+# mode (depth 0) is the A/B reference: pack -> step -> fetch -> apply
+# with no overlap, the sum-of-phases loop the pipeline exists to beat.
+PIPELINE_DEPTH = 2
+PIPELINE_MODES = ("serial", "double")
 IDLE_FLUSH_S = 0.003  # collect leftovers when no new tick arrives
 
 
@@ -249,8 +266,33 @@ class FusedBucket:
         # floor is generous (4 KB of -1s) because a mid-serving growth
         # costs a recompile — seconds of p99 — while padding costs ~µs
         self.ack_capacity = 1024
+        # double-buffered packed-wire staging (models/reconcile_model.py):
+        # tick N+1 packs into the other buffer while tick N's device_put
+        # may still be reading this one — the allocation-free hot path
+        # that makes the 2-deep pipeline window safe
+        self._wire_bufs = WireBuffers(PIPELINE_DEPTH)
+        # state donation is per-backend: on accelerators the donated
+        # resident state is the design (steady state lives in HBM, only
+        # deltas cross the link). The CPU pjrt client (jaxlib 0.4.36)
+        # however mishandles donation under the pipelined window — an
+        # output wire held across subsequent donated steps hits a
+        # use-after-free (fuzz-reproducible segfault at depth 2, rare
+        # flake at depth 1: outputs alias donated input buffers and the
+        # client's aliasing bookkeeping breaks once >1 step chains
+        # through them). On CPU donation only saves allocator churn (no
+        # HBM, outputs are written wholesale either way), so correctness
+        # wins. KCP_DONATE=0/1 overrides the backend default.
+        env_donate = os.environ.get("KCP_DONATE", "")
+        if env_donate in ("0", "1"):
+            self.donate = env_donate == "1"
+        else:
+            try:
+                self.donate = jax.default_backend() != "cpu"
+            except Exception:  # noqa: BLE001 — backend init failure
+                self.donate = False
         self._step = jax.jit(
-            reconcile_step_packed, donate_argnums=(0,),
+            reconcile_step_packed,
+            donate_argnums=(0,) if self.donate else (),
             static_argnames=("patch_capacity", "use_pallas", "mesh"),
         )
         self.stats = {"ticks": 0, "full_uploads": 0, "overflows": 0,
@@ -516,8 +558,8 @@ class FusedBucket:
             self.stats["full_uploads"] += 1
             # full upload replaces the mirrors wholesale; still run the
             # step so decisions for the new state come back
-            packed = np.zeros((MIN_EVENTS, s + 2), np.uint32)
-            acks = np.full(self.ack_capacity, -1, np.int32)
+            buf_slot, packed, acks = self._wire_bufs.acquire(
+                MIN_EVENTS, s + 2, self.ack_capacity)
         else:
             if self._pl_staged:
                 # placement inputs changed (roots staged/retired): swap
@@ -547,7 +589,6 @@ class FusedBucket:
             nf = n - na
             nm = len(self._staged_masks)
             d = pad_pow2(nf + nm, floor=MIN_EVENTS)
-            packed = np.zeros((d, s + 2), np.uint32)
             # always ship the acks array, even all-padding: an acks=None
             # fast path would be a SECOND jit trace variant, and the
             # first ack-bearing tick would then compile it mid-serving —
@@ -555,7 +596,8 @@ class FusedBucket:
             # all-dropped scatter pass costs per tick
             while self.ack_capacity < na:
                 self.ack_capacity *= 2
-            acks = np.full(self.ack_capacity, -1, np.int32)
+            buf_slot, packed, acks = self._wire_bufs.acquire(
+                d, s + 2, self.ack_capacity)
             if na:
                 self.stats["acked"] += na
                 full_sel = ~ack_sel
@@ -584,7 +626,12 @@ class FusedBucket:
         else:
             packed = jax.device_put(packed)
             acks = jax.device_put(acks)
+        # the staging buffers may be re-acquired only after these device
+        # arrays materialize (async dispatch: device_put can still be
+        # reading the host memory after it returns)
+        self._wire_bufs.commit(buf_slot, packed, acks)
         t2 = time.perf_counter()
+        _phase("put", t2 - t1)
         k = min(self.patch_capacity, self.B)
         self._state, wire = self._step(
             self._state, packed, acks, patch_capacity=k,
@@ -595,7 +642,6 @@ class FusedBucket:
         # a stale tick's t1-t0 is the whole-mirror device upload, not the
         # steady-state pack — keep the histograms separable
         _phase("full_upload" if was_stale else "pack", t1 - t0)
-        _phase("put", t2 - t1)
         _phase("step_dispatch", t3 - t2)
         self.stats["ticks"] += 1
         return wire, (k, int(self._state.avail.shape[1]))
@@ -641,14 +687,32 @@ class FusedCore:
     _instances: dict[int, "FusedCore"] = {}
 
     def __init__(self, mesh=None, batch_window: float = 0.002,
-                 use_pallas: bool | None = None):
+                 use_pallas: bool | None = None,
+                 pipeline: str | None = None):
         self.mesh = mesh
         if use_pallas is None:
             use_pallas = os.environ.get("KCP_PALLAS", "") == "1"
         self.use_pallas = use_pallas
+        # tick pipelining mode: "double" (default) keeps up to
+        # PIPELINE_DEPTH steps in flight per bucket — pack N+1 and apply
+        # N-1 while the device runs N; "serial" collects every wire in
+        # the tick that submitted it (the A/B reference for bench.py
+        # --pipeline and the equivalence fuzz)
+        if pipeline is None:
+            pipeline = os.environ.get("KCP_PIPELINE", "") or "double"
+        if pipeline not in PIPELINE_MODES:
+            raise ValueError(f"pipeline must be one of {PIPELINE_MODES}, "
+                             f"got {pipeline!r}")
+        self.pipeline = pipeline
+        self.fetch_depth = PIPELINE_DEPTH if pipeline == "double" else 0
+        REGISTRY.gauge(
+            "fused_pipeline_window",
+            "configured in-flight tick window (0 = serial mode)",
+        ).set(self.fetch_depth)
         self.buckets: dict[int, FusedBucket] = {}
         self.controller = BatchController(
-            "fused-core", self._process_batch, batch_window=batch_window
+            "fused-core", self._process_batch, batch_window=batch_window,
+            overlap_drain=(pipeline == "double"),
         )
         self._inflight: list[
             tuple[FusedBucket, jax.Array, tuple[int, int]]
@@ -662,14 +726,16 @@ class FusedCore:
     # ---------------------------------------------------------- lifecycle
 
     @classmethod
-    def for_current_loop(cls, mesh=None) -> "FusedCore":
+    def for_current_loop(cls, mesh=None,
+                         pipeline: str | None = None) -> "FusedCore":
         """The process-wide core for the running asyncio loop (tests run
         many loops sequentially; each gets a fresh core).
 
         ``mesh=None`` falls back to the process serving mesh
         (parallel.mesh.set_serving_mesh — the server's Config.mesh /
         --mesh flag), so a configured process serves sharded without
-        every engine re-plumbing the mesh."""
+        every engine re-plumbing the mesh. ``pipeline=None`` falls back
+        to ``KCP_PIPELINE`` (default "double")."""
         if mesh is None:
             from ..parallel.mesh import get_serving_mesh
 
@@ -682,12 +748,16 @@ class FusedCore:
         # the identity check guards against id() reuse after a dead loop
         # is garbage-collected: a stale core's tick task died with its loop
         if core is None or core._closed() or core._loop is not loop:
-            core = cls(mesh=mesh)
+            core = cls(mesh=mesh, pipeline=pipeline)
             core._loop = loop
             cls._instances[id(loop)] = core
-        elif mesh is not None and core.mesh != mesh:
-            log.warning("FusedCore for this loop already exists with a "
-                        "different mesh; keeping the existing core's mesh")
+        else:
+            if mesh is not None and core.mesh != mesh:
+                log.warning("FusedCore for this loop already exists with a "
+                            "different mesh; keeping the existing core's mesh")
+            if pipeline is not None and core.pipeline != pipeline:
+                log.warning("FusedCore for this loop already exists with "
+                            "pipeline=%s; keeping it", core.pipeline)
         return core
 
     def _closed(self) -> bool:
@@ -703,11 +773,15 @@ class FusedCore:
         self._refs -= 1
         if self._refs > 0:
             return
+        # controller first: its shutdown drain runs the FINAL ticks, and
+        # those submits append in-flight wires — draining _inflight before
+        # the tick loop exits would strand (and silently drop) the last
+        # window's patches (proven by the pipeline shutdown/drain test)
+        await self.controller.stop()
         if self._flush_task is not None:
             self._flush_task.cancel()
             self._flush_task = None
         await self._drain_inflight()
-        await self.controller.stop()
         # drop the registry entry so closed cores (and their device-
         # resident bucket state) do not accumulate across loops
         for k, v in list(FusedCore._instances.items()):
@@ -776,7 +850,17 @@ class FusedCore:
         if touched:
             _phase("encode", time.perf_counter() - t0)
 
-        # 2. one fused step per dirty bucket; collection is pipelined
+        # 2. one fused step per dirty bucket; collection is pipelined.
+        #    Occupancy telemetry per submit: how deep the in-flight window
+        #    already was (depth histogram) and whether this dispatch
+        #    overlapped an executing step (the pipeline's whole point)
+        inflight_by_bucket: dict[int, int] = {}
+        for b, _w, _m in self._inflight:
+            inflight_by_bucket[id(b)] = inflight_by_bucket.get(id(b), 0) + 1
+        depth_h = REGISTRY.histogram(
+            "fused_pipeline_depth",
+            "in-flight steps per bucket at submit time",
+            buckets=DEPTH_BUCKETS)
         for bucket in self.buckets.values():
             try:
                 submitted = bucket.submit()
@@ -789,12 +873,21 @@ class FusedCore:
                 raise
             if submitted is not None:
                 wire, meta = submitted
+                depth = inflight_by_bucket.get(id(bucket), 0)
+                depth_h.observe(depth)
+                if depth:
+                    REGISTRY.counter(
+                        "fused_pipeline_overlap_ticks_total",
+                        "submits issued while a previous step was still "
+                        "in flight (overlapped ticks)").inc()
                 self._inflight.append((bucket, wire, meta))
 
-        # 3. collect: per BUCKET, oldest in-flight wires beyond FETCH_DEPTH
-        #    (blocking is fine by then — their data has had a full tick to
-        #    land). Depth is per bucket so one bucket's fresh wire never
-        #    forces a zero-depth blocking collect of another's.
+        # 3. collect: per BUCKET, oldest in-flight wires beyond the
+        #    pipeline window (blocking is fine by then — their data has
+        #    had fetch_depth full ticks to land; serial mode, depth 0,
+        #    collects everything including this tick's own wire). Depth
+        #    is per bucket so one bucket's fresh wire never forces a
+        #    zero-depth blocking collect of another's.
         #    (Measured and rejected: collecting already-ready wires
         #    opportunistically — on a synchronous backend every wire is
         #    instantly "ready", which serializes dispatch into the tick
@@ -805,13 +898,14 @@ class FusedCore:
         i = 0
         while i < len(self._inflight):
             b, w, m = self._inflight[i]
-            if counts[id(b)] > FETCH_DEPTH:
+            if counts[id(b)] > self.fetch_depth:
                 self._inflight.pop(i)
                 counts[id(b)] -= 1
                 self._collect(b, w, m)
             else:
                 i += 1
-        self._schedule_flush()
+        if self._inflight:
+            self._schedule_flush()
         return []
 
     def _encode_section(self, section: Section, keymasks: dict) -> None:
@@ -875,6 +969,19 @@ class FusedCore:
     def _collect(self, bucket: FusedBucket, wire: jax.Array,
                  meta: tuple[int, int]) -> None:
         t0 = time.perf_counter()
+        # fetch blocks ONLY on the compact wire (copy_to_host_async was
+        # issued at dispatch) — never on the donated resident state. The
+        # ready split is the pipeline-occupancy answer: a blocked fetch
+        # means the host outran the device by the full window.
+        try:
+            ready = bool(wire.is_ready())
+        except AttributeError:  # plain ndarray in tests
+            ready = True
+        REGISTRY.counter(
+            "fused_collect_ready_total" if ready
+            else "fused_collect_blocked_total",
+            "fetches that found the wire already on host (ready) vs had "
+            "to wait for the device (blocked)").inc()
         host_wire = np.asarray(wire)
         t1 = time.perf_counter()
         overflow = bucket.dispatch(host_wire, meta)

@@ -33,6 +33,11 @@ _DEFAULT_BUCKETS = (
     0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
 )
 
+# small-integer occupancy histograms (e.g. the fused tick pipeline's
+# in-flight depth, ``fused_pipeline_depth``): the time-shaped default
+# edges would fold every observation into one bucket
+DEPTH_BUCKETS = (0.0, 1.0, 2.0, 3.0, 4.0, 8.0)
+
 
 @dataclass
 class Counter:

@@ -30,6 +30,24 @@ make -s -C native kcptok.so
 echo "== tests: full suite, race-checked (KCP_RACE=1 via conftest)"
 python -m pytest tests/ -q
 
+echo "== bench: CPU smoke of the serial-vs-pipelined tick A/B (tiny shape)"
+ab_line=$(JAX_PLATFORMS=cpu KCP_BENCH_CHILD=1 KCP_BENCH_ROWS=2048 \
+    KCP_BENCH_CHURN=64 KCP_BENCH_WARMUP=6 KCP_BENCH_SEGMENTS=1 \
+    KCP_BENCH_SEGMENT_S=1 python bench.py --pipeline double | tail -1)
+printf '%s\n' "$ab_line" | python -c '
+import json, sys
+r = json.loads(sys.stdin.readline())
+ab = r.get("pipeline_ab") or {}
+assert set(ab) == {"serial", "double"}, f"A/B modes missing: {sorted(ab)}"
+for mode, res in ab.items():
+    assert res.get("value", 0) > 0, f"{mode}: no measured rate"
+    assert res.get("segment_rates"), f"{mode}: no per-segment rates"
+    assert "convergence_p99_ms" in res, f"{mode}: no convergence percentiles"
+print("pipeline A/B smoke ok:",
+      {m: res["value"] for m, res in ab.items()},
+      "| speedup:", r.get("pipeline_speedup"))
+'
+
 if [[ "$fast" == "0" ]]; then
     echo "== demo: both golden scenarios, checked against committed output"
     python contrib/demo/run_demo.py all --check

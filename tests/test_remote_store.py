@@ -140,6 +140,53 @@ def test_expired_watch_window_surfaces_through_frontend(pair):
     asyncio.run(main())
 
 
+def test_backend_refusal_surfaces_through_frontend_watch():
+    """A backend refusal that is NOT a 410 (here: 403 from a missing
+    --store-token against an authz'd backend) must reach the watching
+    client as a terminal in-stream Status with the mapped code — not a
+    silently dropped connection (ADVICE r5, handler watch relay).
+
+    tls=False: this path exercises the relay's error mapping, not
+    transport security (and the slim test image has no cryptography)."""
+    with ServerThread(Config(durable=False, install_controllers=False,
+                             authz=True, tls=False)) as backend:
+        # no store_token: every relayed verb is rejected 403 by the
+        # backend. install_controllers now defaults OFF with
+        # store_server, so the frontend still starts cleanly.
+        with ServerThread(Config(durable=False, tls=False,
+                                 store_server=backend.address)) as frontend:
+            assert frontend.server.install_controllers is False
+
+            async def main():
+                fc = RestClient(frontend.address, cluster="tz")
+                w = fc.watch("configmaps")
+                with pytest.raises(errors.ApiError) as exc:
+                    await w.next_batch(max_wait=5.0)
+                # the real code relayed, not a flattened 500 or a 410
+                assert exc.value.code == 403
+                assert not isinstance(exc.value, errors.ConflictError)
+                w.close()
+
+            asyncio.run(main())
+
+
+def test_store_server_rejects_inproc_controllers():
+    """install_controllers=True with store_server is the event-loop
+    hazard (blocking RemoteStore HTTP on the serving loop): hard error
+    unless force_remote_controllers explicitly accepts it."""
+    from kcp_tpu.server.server import Server
+
+    with pytest.raises(ValueError):
+        Server(Config(durable=False, install_controllers=True, tls=False,
+                      store_server="http://127.0.0.1:1"))
+    # the explicit override constructs (it only relaxes the guard)
+    s = Server(Config(durable=False, install_controllers=True, tls=False,
+                      force_remote_controllers=True,
+                      store_server="http://127.0.0.1:1"))
+    assert s.install_controllers is True
+    s.store.close()
+
+
 def test_syncer_through_frontend(pair):
     """Full control-plane integration: a syncer whose UPSTREAM client is
     the frontend (informers ride the frontend's relayed watch streams;
