@@ -1,0 +1,243 @@
+"""Deterministic fault injection (``KCP_FAULTS``).
+
+The north-star loop serves 10k logical clusters out of ONE fused device
+program — at that blast radius "we handle failures" is not a claim that
+can rest on production incidents. This module makes every failure mode a
+*replayable input*: a ``KCP_FAULTS`` spec names injection points threaded
+through the store, the REST client/watch relay, the syncer apply path and
+the fused device step, and a seeded per-point PRNG makes any schedule
+reproducible bit-for-bit (same spec + seed + call sequence = same faults).
+
+Spec grammar — semicolon-separated clauses, each ``point:action``::
+
+    KCP_FAULTS="store.put:error=0.05;watch:drop@tick=200;\
+device.step:raise@tick=57;syncer.apply:latency=50ms"
+    KCP_FAULTS_SEED=1337
+
+    clause  := <point> ":" <action> [ "=" <value> ] [ "@tick=" <n> ]
+    action  := error | raise | drop | latency | poison_row
+    value   := probability (0.05) | duration (50ms, 2s) | row index
+
+- ``error``      raise :class:`~kcp_tpu.utils.errors.UnavailableError`
+                 (an injected 503 — exercises retry/backoff/circuit paths)
+- ``raise``      raise :class:`InjectedFault` (a non-API RuntimeError —
+                 exercises the crash paths, e.g. a device-step failure)
+- ``drop``       ask the site to drop its stream (watch connection loss)
+- ``latency``    add ``value`` seconds of delay at the site
+- ``poison_row`` fire whenever the site's ``rows`` metadata contains the
+                 row index in ``value`` (a persistently-poisoned wire row
+                 — what the FusedCore quarantine bisection hunts)
+
+``@tick=N`` fires exactly on the Nth invocation of the point (1-based);
+without it, ``value`` is a per-invocation probability (``error``/``drop``)
+or always-on (``latency``/``poison_row``; ``raise`` with no value fires
+every time).
+
+Injection points wired in this codebase:
+
+    store.put / store.get / store.list / store.delete   store/store.py
+    watch                        store Watch + server/rest.py RestWatch
+    rest.request                 server/rest.py RestClient._request
+    syncer.apply                 syncer/engine.py applier pool
+    device.step                  syncer/core.py FusedBucket.submit/probe
+    cluster.health               reconcilers/cluster pull-mode healthcheck
+
+Sites call the module-level helpers, which are near-free no-ops when no
+injector is active (one global read).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import threading
+from dataclasses import dataclass, field
+
+from .utils.errors import UnavailableError
+from .utils.trace import REGISTRY
+
+log = logging.getLogger(__name__)
+
+ACTIONS = ("error", "raise", "drop", "latency", "poison_row")
+
+
+class InjectedFault(RuntimeError):
+    """A deliberately-injected non-API failure (``raise``/``poison_row``)."""
+
+
+@dataclass
+class FaultRule:
+    point: str
+    action: str
+    value: float | None = None
+    at_tick: int | None = None
+    fired: int = 0
+
+
+def _parse_value(raw: str) -> float:
+    if raw.endswith("ms"):
+        return float(raw[:-2]) / 1000.0
+    if raw.endswith("s"):
+        return float(raw[:-1])
+    return float(raw)
+
+
+def parse_spec(spec: str) -> list[FaultRule]:
+    rules: list[FaultRule] = []
+    for clause in spec.split(";"):
+        clause = clause.strip()
+        if not clause:
+            continue
+        point, _, rest = clause.partition(":")
+        if not rest:
+            raise ValueError(f"fault clause {clause!r} needs '<point>:<action>'")
+        at_tick: int | None = None
+        if "@" in rest:
+            rest, _, mod = rest.partition("@")
+            mkey, _, mval = mod.partition("=")
+            if mkey != "tick":
+                raise ValueError(f"unknown fault modifier {mod!r} in {clause!r}")
+            at_tick = int(mval)
+        action, _, raw = rest.partition("=")
+        if action not in ACTIONS:
+            raise ValueError(
+                f"unknown fault action {action!r} in {clause!r} "
+                f"(one of {', '.join(ACTIONS)})")
+        value = _parse_value(raw) if raw else None
+        rules.append(FaultRule(point.strip(), action, value, at_tick))
+    return rules
+
+
+@dataclass
+class _PointState:
+    rules: list[FaultRule] = field(default_factory=list)
+    count: int = 0
+    rng: random.Random | None = None
+
+
+class FaultInjector:
+    """A parsed, seeded fault schedule; thread-safe (REST clients and the
+    store-pool executor hit points off the serving loop)."""
+
+    def __init__(self, spec: str = "", seed: int = 0):
+        self.spec = spec
+        self.seed = seed
+        self._lock = threading.Lock()
+        self._points: dict[str, _PointState] = {}
+        for rule in parse_spec(spec):
+            st = self._points.setdefault(rule.point, _PointState())
+            st.rules.append(rule)
+        for point, st in self._points.items():
+            # per-point PRNG: a point's schedule depends only on its own
+            # invocation sequence, never on interleaving with other points
+            st.rng = random.Random(f"{seed}:{point}")
+
+    def describe(self) -> str:
+        return f"KCP_FAULTS={self.spec!r} seed={self.seed}"
+
+    # ------------------------------------------------------------ firing
+
+    def _advance(self, point: str, rows=None) -> list[FaultRule]:
+        st = self._points.get(point)
+        if st is None:
+            return []
+        with self._lock:
+            st.count += 1
+            fired: list[FaultRule] = []
+            for r in st.rules:
+                if r.action == "poison_row":
+                    if (rows is not None and r.value is not None
+                            and int(r.value) in rows):
+                        fired.append(r)
+                    continue
+                if r.at_tick is not None:
+                    if st.count == r.at_tick:
+                        fired.append(r)
+                    continue
+                if r.action == "latency":
+                    fired.append(r)
+                    continue
+                p = 1.0 if r.value is None else r.value
+                if st.rng.random() < p:
+                    fired.append(r)
+            for r in fired:
+                r.fired += 1
+        for r in fired:
+            REGISTRY.counter(
+                "fault_injected_total",
+                "faults fired by the KCP_FAULTS injector").inc()
+            REGISTRY.counter(
+                f"fault_injected_{point.replace('.', '_')}_total",
+                f"faults fired at the {point} injection point").inc()
+            log.info("fault injected: %s:%s (invocation %d)",
+                     point, r.action, st.count)
+        return fired
+
+    def maybe_fail(self, point: str, rows=None) -> float:
+        """Advance ``point``'s schedule. Raises if an ``error`` (503) /
+        ``raise`` / matching ``poison_row`` rule fires; returns the summed
+        ``latency`` delay in seconds otherwise (0.0 when quiet)."""
+        delay = 0.0
+        for r in self._advance(point, rows):
+            if r.action == "latency":
+                delay += r.value or 0.0
+            elif r.action == "error":
+                raise UnavailableError(f"injected fault: {point}:error")
+            elif r.action == "raise":
+                raise InjectedFault(f"injected fault: {point}:raise")
+            elif r.action == "poison_row":
+                raise InjectedFault(
+                    f"injected fault: {point}:poison_row={int(r.value)}")
+        return delay
+
+    def should_drop(self, point: str) -> bool:
+        """Advance ``point``'s schedule; True if a ``drop`` rule fired."""
+        return any(r.action == "drop" for r in self._advance(point))
+
+    def snapshot(self) -> dict[str, int]:
+        """point -> invocation count (replay/debugging aid)."""
+        with self._lock:
+            return {p: st.count for p, st in self._points.items()}
+
+
+# --------------------------------------------------------------------------
+# Process-global injector: KCP_FAULTS env (read once) or install()ed by
+# tests / the chaos harness. Sites call the module helpers below.
+# --------------------------------------------------------------------------
+
+_ACTIVE: FaultInjector | None = None
+_ENV_CHECKED = False
+
+
+def active() -> FaultInjector | None:
+    global _ACTIVE, _ENV_CHECKED
+    if not _ENV_CHECKED:
+        _ENV_CHECKED = True
+        spec = os.environ.get("KCP_FAULTS", "")
+        if spec:
+            _ACTIVE = FaultInjector(
+                spec, int(os.environ.get("KCP_FAULTS_SEED", "0")))
+            log.warning("fault injection ACTIVE: %s", _ACTIVE.describe())
+    return _ACTIVE
+
+
+def install(inj: FaultInjector | None) -> None:
+    """Activate an injector programmatically (tests, chaos harnesses)."""
+    global _ACTIVE, _ENV_CHECKED
+    _ACTIVE = inj
+    _ENV_CHECKED = True
+
+
+def clear() -> None:
+    install(None)
+
+
+def maybe_fail(point: str, rows=None) -> float:
+    inj = _ACTIVE if _ENV_CHECKED else active()
+    return inj.maybe_fail(point, rows) if inj is not None else 0.0
+
+
+def should_drop(point: str) -> bool:
+    inj = _ACTIVE if _ENV_CHECKED else active()
+    return inj.should_drop(point) if inj is not None else False

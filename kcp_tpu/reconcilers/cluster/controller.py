@@ -159,9 +159,19 @@ class ClusterController:
             await self._restart_syncer(key, cluster, scoped, physical, sorted(synced))
             cluster = scoped.get(clusterapi.CLUSTERS, name)
 
-        # 4. pull-mode health check (cluster.go:175-194)
+        # 4. pull-mode health check (cluster.go:175-194). `cluster.health`
+        #    is a KCP_FAULTS injection point: an injected error reads as
+        #    an unhealthy syncer, so chaos schedules can flap a cluster's
+        #    Ready condition deterministically (the flip feeds the
+        #    deployment splitter's health-gated evacuation)
         if self.mode == SyncerMode.PULL and clusterapi.synced_resources(cluster):
             healthy, msg = installer.healthcheck_syncer(physical)
+            try:
+                from ... import faults
+
+                faults.maybe_fail("cluster.health")
+            except Exception as err:  # noqa: BLE001 — injected unhealth
+                healthy, msg = False, f"injected fault: {err}"
             if not healthy:
                 self._set_status(scoped, cluster, ready=False,
                                  reason=clusterapi.REASON_SYNCER_NOT_READY, message=msg)
@@ -216,10 +226,19 @@ class ClusterController:
         fresh = scoped.get(clusterapi.CLUSTERS, name)
         if synced is not None:
             clusterapi.set_synced_resources(fresh, synced)
+        was_ready = clusterapi.is_ready(fresh)
         if ready is True:
             clusterapi.set_ready(fresh, reason, message)
         elif ready is False:
             clusterapi.set_not_ready(fresh, reason, message)
+        if ready is not None and ready != was_ready:
+            # flip telemetry: the evacuation runbook's flap-rate signal
+            from ...utils.trace import REGISTRY
+
+            REGISTRY.counter(
+                "cluster_ready_transitions_total",
+                "Ready condition flips written by the cluster reconciler",
+            ).inc()
         try:
             scoped.update_status(clusterapi.CLUSTERS, fresh)
         except errors.ConflictError:

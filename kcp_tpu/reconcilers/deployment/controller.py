@@ -27,17 +27,20 @@ from __future__ import annotations
 
 import copy
 import logging
+import time
 from typing import Sequence
 
 import numpy as np
 
-from ...apis.cluster import CLUSTERS
+from ...apis.cluster import CLUSTERS, READY
+from ...apis.conditions import FALSE, find_condition
 from ...apis.scheme import GVR
 from ...client import Client, Informer
 from ...ops.encode import pad_pow2
 from ...ops.placement import aggregate_status_jit
 from ...reconciler.controller import BatchController
 from ...utils import errors
+from ...utils.trace import REGISTRY
 
 log = logging.getLogger(__name__)
 
@@ -48,6 +51,11 @@ DEPLOYMENTS = GVR("apps", "v1", "deployments")
 
 _COUNTERS = ("replicas", "updatedReplicas", "readyReplicas",
              "availableReplicas", "unavailableReplicas")
+
+# health-gated evacuation: a cluster must hold NotReady for this long
+# before its leaf deployments drain — a Ready->NotReady->Ready flap
+# inside the window causes ZERO placement churn (hysteresis)
+DEFAULT_EVAC_HYSTERESIS = 5.0
 
 
 def _labels(obj: dict) -> dict:
@@ -72,6 +80,7 @@ class DeploymentSplitter:
         rebalance: bool = False,
         max_pclusters: int = 8,
         core=None,
+        evac_hysteresis: float = DEFAULT_EVAC_HYSTERESIS,
     ):
         self.client = client
         self.backend = backend
@@ -80,6 +89,13 @@ class DeploymentSplitter:
         self._pbucket = None
         self.rebalance = rebalance
         self.max_pclusters = max_pclusters
+        # health-gated evacuation state: when a cluster's Ready condition
+        # went explicitly False, which clusters are drained, and which
+        # roots must re-split even without `rebalance` (drain/readmit)
+        self.evac_hysteresis = evac_hysteresis
+        self._notready_since: dict[tuple[str, str], float] = {}
+        self._evacuated: set[tuple[str, str]] = set()
+        self._force_replan: set[tuple[str, str, str]] = set()
         self.informer = Informer(client, DEPLOYMENTS)
         self.cluster_informer = Informer(client, CLUSTERS)
         self.informer.add_indexer("owned_by", self._owned_by_index)
@@ -124,17 +140,85 @@ class DeploymentSplitter:
             self.controller.enqueue(("leaf", root_key))
 
     def _on_cluster_event(self, etype: str, old: dict | None, new: dict | None) -> None:
+        obj = new or old
+        lc = obj["metadata"].get("clusterName", "")
+        name = obj["metadata"]["name"]
+        ckey = (lc, name)
+        # health gate: the cluster reconciler's Ready flips feed placement
+        # here. NotReady starts the hysteresis clock (a delayed "health"
+        # item decides); Ready clears it — and readmits an evacuated
+        # cluster, re-splitting its logical cluster's roots
+        if etype == "DELETED":
+            self._notready_since.pop(ckey, None)
+            self._evacuated.discard(ckey)
+        elif self._explicitly_not_ready(new):
+            if ckey not in self._notready_since:
+                self._notready_since[ckey] = time.monotonic()
+                self.controller.enqueue_after(
+                    ("health", ckey), self.evac_hysteresis)
+        else:
+            self._notready_since.pop(ckey, None)
+            if ckey in self._evacuated:
+                self._evacuated.discard(ckey)
+                REGISTRY.counter(
+                    "cluster_readmissions_total",
+                    "evacuated clusters readmitted on Ready recovery").inc()
+                log.info("deployment-splitter: cluster %s/%s Ready again; "
+                         "readmitting and re-splitting its roots", lc, name)
+                self._replan_roots(lc)
         # the cluster set changed: with rebalancing on, every root in that
         # logical cluster gets re-planned
         if not self.rebalance:
             return
-        lc = (new or old)["metadata"].get("clusterName", "")
         for obj in self.informer.list():
             if is_root(obj) and obj["metadata"].get("clusterName", "") == lc:
                 m = obj["metadata"]
                 self.controller.enqueue(
                     ("root", (lc, m.get("namespace", ""), m["name"]))
                 )
+
+    # --------------------------------------------- health-gated evacuation
+
+    @staticmethod
+    def _explicitly_not_ready(obj: dict | None) -> bool:
+        """Only a PRESENT Ready condition with status False counts —
+        clusters that never reported health (fresh registrations, test
+        fakes) stay placement-eligible."""
+        if obj is None:
+            return False
+        c = find_condition(obj, READY)
+        return c is not None and c.get("status") == FALSE
+
+    def _replan_roots(self, lc: str) -> None:
+        """Force every root in a logical cluster through a fresh split
+        (drain or readmit must move replicas even without `rebalance`)."""
+        for obj in self.informer.list():
+            if is_root(obj) and obj["metadata"].get("clusterName", "") == lc:
+                m = obj["metadata"]
+                rkey = (lc, m.get("namespace", ""), m["name"])
+                self._force_replan.add(rkey)
+                self.controller.enqueue(("root", rkey))
+
+    def _check_health(self, ckey: tuple[str, str]) -> None:
+        """The delayed hysteresis decision: evacuate only if the cluster
+        is STILL explicitly NotReady a full window after the flip."""
+        lc, name = ckey
+        since = self._notready_since.get(ckey)
+        if since is None or ckey in self._evacuated:
+            return  # recovered within the window (zero churn), or done
+        if not self._explicitly_not_ready(self.cluster_informer.get(lc, name)):
+            self._notready_since.pop(ckey, None)
+            return
+        if time.monotonic() - since < self.evac_hysteresis - 1e-3:
+            return  # a newer flap rescheduled its own check
+        self._evacuated.add(ckey)
+        REGISTRY.counter(
+            "cluster_evacuations_total",
+            "physical clusters drained after sustained NotReady").inc()
+        log.warning("deployment-splitter: evacuating cluster %s/%s after "
+                    "sustained NotReady (> %.1fs)", lc, name,
+                    self.evac_hysteresis)
+        self._replan_roots(lc)
 
     # -------------------------------------------------------------- tick
 
@@ -143,7 +227,9 @@ class DeploymentSplitter:
         roots: dict[tuple[str, str, str], None] = {}
         aggregates: dict[tuple[str, str, str], None] = {}
         for kind, key in items:
-            if kind == "root":
+            if kind == "health":
+                self._check_health(key)
+            elif kind == "root":
                 roots[key] = None
             else:
                 aggregates[key] = None
@@ -163,7 +249,7 @@ class DeploymentSplitter:
                     self._retry_counts.pop(key, None)
                 continue
             leafs = self.informer.index("owned_by", "/".join(key))
-            if leafs and not self.rebalance:
+            if leafs and not self.rebalance and key not in self._force_replan:
                 continue  # reference behavior: only split once
             clusters = self._clusters_for(key[0])
             plan_rows.append((key, root, clusters, leafs))
@@ -306,7 +392,7 @@ class DeploymentSplitter:
             self.controller.enqueue(("root", key))
             return
         leafs = self.informer.index("owned_by", "/".join(key))
-        if leafs and not self.rebalance:
+        if leafs and not self.rebalance and key not in self._force_replan:
             return
         try:
             self._apply_placement(key, root, clusters, leafs, counts)
@@ -332,9 +418,14 @@ class DeploymentSplitter:
     # ------------------------------------------------------------- apply
 
     def _clusters_for(self, logical_cluster: str) -> list[dict]:
+        """Placement-eligible clusters: evacuated (sustained-NotReady)
+        clusters are excluded, so every split — host or fused lane —
+        routes replicas only onto healthy capacity."""
         return sorted(
             (c for c in self.cluster_informer.list()
-             if c["metadata"].get("clusterName", "") == logical_cluster),
+             if c["metadata"].get("clusterName", "") == logical_cluster
+             and (logical_cluster, c["metadata"]["name"])
+             not in self._evacuated),
             key=lambda c: c["metadata"]["name"],
         )
 
@@ -347,8 +438,15 @@ class DeploymentSplitter:
         counts: np.ndarray,
     ) -> None:
         lc, ns, name = key
+        # forced replans (evacuation drain / readmission) move replicas
+        # between existing leafs even when `rebalance` is off
+        forced = key in self._force_replan
         scoped = self.client.scoped(lc)
         if not clusters:
+            if forced:
+                # every cluster is evacuated: drain ALL placed leafs
+                for stale in existing_leafs:
+                    self._drain_leaf(scoped, lc, ns, stale)
             fresh = scoped.get(DEPLOYMENTS, name, ns)
             fresh.setdefault("status", {})["conditions"] = [{
                 "type": "Progressing",
@@ -357,6 +455,7 @@ class DeploymentSplitter:
                 "message": "kcp has no clusters registered to receive Deployments",
             }]
             scoped.update_status(DEPLOYMENTS, fresh, namespace=ns)
+            self._force_replan.discard(key)
             return
         by_name = {leaf["metadata"]["name"]: leaf for leaf in existing_leafs}
         for j, cl in enumerate(clusters):
@@ -383,15 +482,29 @@ class DeploymentSplitter:
                 leaf.setdefault("spec", {})["replicas"] = desired_replicas
                 scoped.create(DEPLOYMENTS, leaf, namespace=ns)
                 self.stats["splits"] += 1
-            elif self.rebalance and (existing.get("spec", {}).get("replicas") != desired_replicas):
+            elif ((self.rebalance or forced)
+                  and existing.get("spec", {}).get("replicas") != desired_replicas):
                 fresh = scoped.get(DEPLOYMENTS, lname, ns)
                 fresh["spec"]["replicas"] = desired_replicas
                 scoped.update(DEPLOYMENTS, fresh, namespace=ns)
                 self.stats["splits"] += 1
-        # rebalance mode: drop leafs for clusters that no longer exist
-        if self.rebalance:
+        # rebalance/forced: drop leafs for clusters that no longer exist
+        # or were evacuated
+        if self.rebalance or forced:
             for stale in by_name.values():
-                scoped.delete(DEPLOYMENTS, stale["metadata"]["name"], ns)
+                self._drain_leaf(scoped, lc, ns, stale)
+        self._force_replan.discard(key)
+
+    def _drain_leaf(self, scoped: Client, lc: str, ns: str, leaf: dict) -> None:
+        try:
+            scoped.delete(DEPLOYMENTS, leaf["metadata"]["name"], ns)
+        except errors.NotFoundError:
+            return
+        if (lc, _labels(leaf).get(CLUSTER_LABEL, "")) in self._evacuated:
+            REGISTRY.counter(
+                "evacuations_total",
+                "leaf deployments drained off evacuated "
+                "(sustained-NotReady) clusters").inc()
 
     def _apply_aggregation(
         self, key: tuple[str, str, str], root: dict, leafs: list[dict], sums: np.ndarray

@@ -18,12 +18,15 @@ import asyncio
 import http.client
 import json
 import threading
+import time
 from urllib.parse import quote, urlsplit
 
 from ..apis.scheme import GVR, ResourceInfo, Scheme, default_scheme
+from ..faults import maybe_fail, should_drop
 from ..store.selectors import LabelSelector
 from ..store.store import WILDCARD, Event
 from ..utils import errors
+from ..utils.circuit import CircuitBreaker
 from ..utils.routing import resolve_write_cluster
 
 
@@ -115,6 +118,11 @@ class RestWatch:
                 return
             buf = b""
             while True:
+                if should_drop("watch"):
+                    # injected stream loss (KCP_FAULTS `watch:drop...`):
+                    # die mid-stream like a dropped connection — the
+                    # informer's reflector loop re-lists and re-watches
+                    break
                 size_line = await reader.readline()
                 if not size_line:
                     break
@@ -276,6 +284,11 @@ class RestClient:
         # refreshes run under it on the caller's own connection, so
         # holding it never waits on another client's in-flight verb.
         self._disc_lock = threading.Lock()
+        # circuit breaker per peer, SHARED by every scoped() clone (like
+        # the discovery cache): a dead backend trips once and every
+        # cluster-scoped client fails fast instead of each burning its
+        # own 30s connect timeouts on the store-I/O executor
+        self._breaker = CircuitBreaker(f"rest_{self._host}_{self._port}")
         self._conn: http.client.HTTPConnection | None = None
 
     def scoped(self, cluster: str) -> "RestClient":
@@ -296,7 +309,24 @@ class RestClient:
         reading the response is only retried for GET — the server may have
         already committed a POST/PUT/DELETE, and re-sending would duplicate
         the write.
+
+        Degraded-mode I/O: the per-peer circuit breaker fails fast
+        (UnavailableError) while the peer is known-dead, counting only
+        transport failures that actually propagate — a stale keep-alive
+        recovered by the retry is not a dead peer, and an HTTP error
+        status is the peer answering. ``rest.request`` is a KCP_FAULTS
+        injection point (error/latency).
         """
+        self._breaker.check()
+        try:
+            delay = maybe_fail("rest.request")
+        except Exception:
+            # injected transport failure: feed the breaker so chaos
+            # schedules exercise the open/half-open transitions
+            self._breaker.record_failure()
+            raise
+        if delay:
+            time.sleep(delay)
         payload = json.dumps(body).encode() if body is not None else None
         headers = {"Content-Type": "application/json"} if payload else {}
         if self.token:
@@ -317,6 +347,7 @@ class RestClient:
                 self._conn = None
                 if reused and attempt == 0:
                     continue
+                self._breaker.record_failure()
                 raise
             try:
                 resp = self._conn.getresponse()
@@ -326,7 +357,9 @@ class RestClient:
                 self._conn = None
                 if method == "GET" and attempt == 0:
                     continue
+                self._breaker.record_failure()
                 raise
+            self._breaker.record_success()
             _raise_for_status(resp.status, data)
             return json.loads(data) if data else None
         return None  # unreachable

@@ -42,6 +42,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Iterator, Mapping
 
+from ..faults import maybe_fail, should_drop
 from ..utils.errors import (
     AlreadyExistsError,
     ConflictError,
@@ -142,6 +143,12 @@ class Watch:
     def _push(self, ev: Event) -> None:
         if self._closed:
             return
+        if should_drop("watch"):
+            # injected stream loss (KCP_FAULTS `watch:drop...`): the event
+            # is lost and the watch dies mid-stream, exactly like a
+            # dropped connection — consumers must re-list (informers do)
+            self.close()
+            return
         self._events.append(ev)
         if self._wakeup is not None:
             self._wakeup.set()
@@ -214,6 +221,15 @@ def _wal_key(key: Key) -> bytes:
 
 
 _WAL_MAGIC = b"KCPWAL1\n"  # stamped by native/walstore.cc on every file
+
+
+def _inject(point: str) -> None:
+    """KCP_FAULTS injection for a store verb: may raise an injected 503
+    (UnavailableError) or sleep an injected latency. Near-free when no
+    injector is active."""
+    delay = maybe_fail(point)
+    if delay:
+        time.sleep(delay)
 
 
 def _detect_wal_format(path: str) -> str | None:
@@ -357,6 +373,7 @@ class LogicalStore:
 
     def create(self, resource: str, cluster: str, obj: dict, namespace: str = "") -> dict:
         self._race_guard.check()
+        _inject("store.put")
         obj = copy.deepcopy(obj)
         meta = obj.setdefault("metadata", {})
         name = meta.get("name")
@@ -391,6 +408,7 @@ class LogicalStore:
         return copy.deepcopy(obj)
 
     def get(self, resource: str, cluster: str, name: str, namespace: str = "") -> dict:
+        _inject("store.get")
         key = self._key(resource, cluster, namespace, name)
         obj = self._objects.get(key)
         if obj is None:
@@ -406,6 +424,7 @@ class LogicalStore:
         subresource: str | None = None,
     ) -> dict:
         self._race_guard.check()
+        _inject("store.put")
         obj = copy.deepcopy(obj)
         meta = self._meta(obj)
         name = meta.get("name")
@@ -471,6 +490,7 @@ class LogicalStore:
 
     def delete(self, resource: str, cluster: str, name: str, namespace: str = "") -> None:
         self._race_guard.check()
+        _inject("store.delete")
         key = self._key(resource, cluster, namespace, name)
         existing = self._objects.get(key)
         if existing is None:
@@ -501,6 +521,7 @@ class LogicalStore:
         selector: LabelSelector | None = None,
     ) -> tuple[list[dict], int]:
         """Return (items, list resourceVersion)."""
+        _inject("store.list")
         selector = selector or everything()
         out = []
         for (res, cl, ns, _name), obj in self._objects.items():
@@ -565,7 +586,9 @@ class LogicalStore:
             copy.deepcopy(old) if old is not None else None,
         )
         self._history.append(ev)
-        for w in self._watches:
+        # snapshot: an injected watch drop closes (and unsubscribes) the
+        # watch from inside _push, mid-iteration
+        for w in list(self._watches):
             out = w._transform(ev)
             if out is not None:
                 w._push(out)

@@ -58,6 +58,7 @@ from typing import Callable, Protocol, Sequence
 import jax
 import numpy as np
 
+from .. import faults
 from ..models.reconcile_model import (
     MASK_STAMP_BIT,
     PACK_HDR,
@@ -110,6 +111,13 @@ MIN_PATCH_CAPACITY = 256
 PIPELINE_DEPTH = 2
 PIPELINE_MODES = ("serial", "double")
 IDLE_FLUSH_S = 0.003  # collect leftovers when no new tick arrives
+# poison-row quarantine: a failed device step is retried once wholesale
+# (full re-upload from the host mirrors); a second consecutive failure
+# bisects the submitted rows with probe steps to isolate the poison.
+# Quarantined keys are requeued to their owners with bounded backoff.
+QUARANTINE_BASE_BACKOFF = 0.05
+QUARANTINE_MAX_BACKOFF = 5.0
+BISECT_MAX_PROBES = 64
 
 
 class SectionOwner(Protocol):
@@ -295,8 +303,17 @@ class FusedBucket:
             donate_argnums=(0,) if self.donate else (),
             static_argnames=("patch_capacity", "use_pallas", "mesh"),
         )
+        # degraded-mode bookkeeping (poison-row quarantine): the rows the
+        # last submission covered (the bisection's suspect set), the
+        # consecutive step-failure count, and the non-donating probe step
+        # used by the bisection (donation would consume the resident
+        # state probes must leave intact)
+        self._last_rows: list[int] = []
+        self._step_failures = 0
+        self._probe_step = None
+        self._dropped_logged: set[int] = set()
         self.stats = {"ticks": 0, "full_uploads": 0, "overflows": 0,
-                      "acked": 0}
+                      "acked": 0, "step_failures": 0, "quarantined": 0}
 
     # ------------------------------------------------------------- rows
 
@@ -556,6 +573,9 @@ class FusedBucket:
             self._clear_staged()
             self._pl_staged = False
             self.stats["full_uploads"] += 1
+            # a full upload re-submits every owned row — they are all
+            # suspects if this step fails (quarantine bisection input)
+            self._last_rows = sorted(self.row_owner)
             # full upload replaces the mirrors wholesale; still run the
             # step so decisions for the new state come back
             buf_slot, packed, acks = self._wire_bufs.acquire(
@@ -615,6 +635,9 @@ class FusedBucket:
                 packed[nf:nf + nm, : masks.shape[1]] = masks.astype(np.uint32)
                 packed[nf:nf + nm, s] = mrows
                 packed[nf:nf + nm, s + 1] = 4 | MASK_STAMP_BIT
+            rows_touched = set(self._staged_rows[:n].tolist())
+            rows_touched.update(self._staged_masks)
+            self._last_rows = sorted(rows_touched)
             self._clear_staged()
         t1 = time.perf_counter()
         if self.mesh is not None:
@@ -633,10 +656,15 @@ class FusedBucket:
         t2 = time.perf_counter()
         _phase("put", t2 - t1)
         k = min(self.patch_capacity, self.B)
+        # KCP_FAULTS `device.step` injection point (raise@tick / error /
+        # poison_row): fires HERE, where a real XLA dispatch failure
+        # would surface — the quarantine machinery recovers either way
+        faults.maybe_fail("device.step", rows=self._last_rows)
         self._state, wire = self._step(
             self._state, packed, acks, patch_capacity=k,
             use_pallas=self.use_pallas, mesh=self.mesh,
         )
+        self._step_failures = 0
         wire.copy_to_host_async()
         t3 = time.perf_counter()
         # a stale tick's t1-t0 is the whole-mirror device upload, not the
@@ -646,6 +674,120 @@ class FusedBucket:
         self.stats["ticks"] += 1
         return wire, (k, int(self._state.avail.shape[1]))
 
+    # ------------------------------------------------------- quarantine
+
+    def probe_rows(self, rows: Sequence[int]) -> bool:
+        """Run one trial step over a synthetic wire carrying only
+        ``rows`` (both sides, from the host mirrors), discarding the
+        result. True iff the step completed — the bisection's oracle.
+
+        The probe jit does NOT donate: the resident state must survive
+        an arbitrary number of probes. Probe wire shapes are pow2-padded,
+        so a bisection compiles at most a handful of variants (this is
+        the rare failure path; docs/operations.md covers the cost)."""
+        if self.B == 0:
+            return True
+        rows = [int(r) for r in rows]
+        try:
+            faults.maybe_fail("device.step", rows=rows)
+            if self._probe_step is None:
+                self._probe_step = jax.jit(
+                    reconcile_step_packed,
+                    static_argnames=("patch_capacity", "use_pallas", "mesh"))
+            if self._state is None:
+                self._state = self._device_state()
+                self._stale = False
+            s = self.S
+            d = pad_pow2(max(2 * len(rows), 1), floor=MIN_EVENTS)
+            packed = np.zeros((d, s + 2), np.uint32)
+            for i, row in enumerate(rows):
+                packed[2 * i, :s] = self.up_vals[row]
+                packed[2 * i, s] = row
+                packed[2 * i, s + 1] = (1 if self.up_exists[row] else 0) | 4
+                packed[2 * i + 1, :s] = self.down_vals[row]
+                packed[2 * i + 1, s] = row
+                packed[2 * i + 1, s + 1] = (
+                    (1 if self.down_exists[row] else 0) | 2 | 4)
+            acks = np.full(self.ack_capacity, -1, np.int32)
+            if self.mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                repl = NamedSharding(self.mesh, PartitionSpec())
+                packed = jax.device_put(packed, repl)
+                acks = jax.device_put(acks, repl)
+            _state, wire = self._probe_step(
+                self._state, packed, acks,
+                patch_capacity=min(self.patch_capacity, self.B),
+                use_pallas=self.use_pallas, mesh=self.mesh)
+            np.asarray(wire)  # force execution; async backends defer errors
+            return True
+        except Exception:  # noqa: BLE001 — any failure means "poisoned"
+            return False
+
+    def bisect_poison(self, suspects: Sequence[int],
+                      max_probes: int = BISECT_MAX_PROBES) -> list[int] | None:
+        """Isolate the rows whose presence makes the step fail, by
+        group-testing probe steps (~k*log2(n) probes for k poisons).
+
+        Returns None when even an EMPTY probe fails — the failure is
+        row-independent and quarantine cannot help. If the probe budget
+        runs out, the unresolved remainder is quarantined wholesale
+        (innocents may be swept up; degraded beats dead, and their
+        requeue brings them back)."""
+        if not self.probe_rows([]):
+            return None
+        bad: list[int] = []
+        stack: list[list[int]] = [[int(r) for r in suspects]]
+        probes = 0
+        while stack:
+            rows = stack.pop()
+            if not rows:
+                continue
+            if probes >= max_probes:
+                log.warning("fused-core: bisection probe budget exhausted; "
+                            "quarantining %d unresolved rows wholesale",
+                            len(rows))
+                bad.extend(rows)
+                continue
+            probes += 1
+            if self.probe_rows(rows):
+                continue
+            if len(rows) == 1:
+                bad.append(rows[0])
+            else:
+                mid = len(rows) // 2
+                stack.append(rows[:mid])
+                stack.append(rows[mid:])
+        return bad
+
+    def quarantine_row(self, row: int) -> tuple[object | None, Section | None]:
+        """Evict one poisoned row: zero its host mirrors (the pending
+        full re-upload then excludes it from the resident state), free
+        the row, and return (key, section) so the core can requeue the
+        key to its owner with bounded backoff. One bad object must never
+        stall its bucket's co-tenants."""
+        sec = self.row_owner.get(row)
+        key = sec.row_keys.get(row) if sec is not None else None
+        self.up_vals[row] = 0
+        self.down_vals[row] = 0
+        self.up_exists[row] = False
+        self.down_exists[row] = False
+        self.status_mask[row] = False
+        if sec is not None:
+            if key is not None:
+                sec.rows.pop(key, None)
+            sec.row_keys.pop(row, None)
+            self.row_owner.pop(row, None)
+            self._free.append(row)
+        self.stats["quarantined"] += 1
+        REGISTRY.counter(
+            "quarantined_rows",
+            "rows evicted from fused buckets by poison-row quarantine").inc()
+        self.mark_stale()
+        return key, sec
+
+    # ----------------------------------------------------------- routing
+
     def dispatch(self, wire: np.ndarray, meta: tuple[int, int]) -> bool:
         """Route a collected wire's patches (and dirty placement rows) to
         their owners.
@@ -654,13 +796,28 @@ class FusedBucket:
         doubling capacity)."""
         idx, code, upsync, overflow, _stats = unpack_patches(wire)
         per_section: dict[Section, list[tuple[object, int, bool]]] = {}
+        dropped = 0
         for r, c, u in zip(idx.tolist(), code.tolist(), upsync.tolist()):
             s = self.row_owner.get(r)
-            if s is None:
+            key = s.row_keys.get(r) if s is not None else None
+            if key is None:
+                # an unowned/unkeyed patch row (released section, freed or
+                # quarantined row, in-flight wire racing a retirement):
+                # benign by design, but it must be COUNTED, not silent
+                dropped += 1
+                if r not in self._dropped_logged:
+                    self._dropped_logged.add(r)
+                    log.warning(
+                        "fused-core: dropping patch for row %d (%s); "
+                        "counted in fused_dropped_patch_rows", r,
+                        "no owning section" if s is None else "no key mapping")
                 continue
-            key = s.row_keys.get(r)
-            if key is not None:
-                per_section.setdefault(s, []).append((key, c, u))
+            per_section.setdefault(s, []).append((key, c, u))
+        if dropped:
+            REGISTRY.counter(
+                "fused_dropped_patch_rows",
+                "patch rows dropped at dispatch because their row had no "
+                "owner/key (released, freed, or quarantined)").inc(dropped)
         for s, patches in per_section.items():
             s.owner.fused_apply(patches)
         if self.placement_owner is not None:
@@ -719,8 +876,12 @@ class FusedCore:
         ] = []
         self._flush_task: asyncio.Task | None = None
         self._eager_collect: bool | None = None  # resolved on first flush
+        # quarantined keys awaiting their bounded-backoff requeue
+        self._quarantine_retries: dict[tuple[int, object], int] = {}
         self._refs = 0
         self._started = False
+        self._stopping = False
+        self._stop_done: asyncio.Event | None = None
         self._loop = None
 
     # ---------------------------------------------------------- lifecycle
@@ -770,23 +931,37 @@ class FusedCore:
             await self.controller.start()
 
     async def stop(self) -> None:
-        self._refs -= 1
         if self._refs > 0:
+            self._refs -= 1
+        if self._refs > 0 or not self._started:
             return
-        # controller first: its shutdown drain runs the FINAL ticks, and
-        # those submits append in-flight wires — draining _inflight before
-        # the tick loop exits would strand (and silently drop) the last
-        # window's patches (proven by the pipeline shutdown/drain test)
-        await self.controller.stop()
-        if self._flush_task is not None:
-            self._flush_task.cancel()
-            self._flush_task = None
-        await self._drain_inflight()
-        # drop the registry entry so closed cores (and their device-
-        # resident bucket state) do not accumulate across loops
-        for k, v in list(FusedCore._instances.items()):
-            if v is self:
-                del FusedCore._instances[k]
+        if self._stopping:
+            # double-stop (or stop concurrent with an in-flight stop):
+            # an idempotent no-op — wait for the first stop's drain so
+            # every caller returns to a fully-drained core
+            if self._stop_done is not None:
+                await self._stop_done.wait()
+            return
+        self._stopping = True
+        self._stop_done = asyncio.Event()
+        try:
+            # controller first: its shutdown drain runs the FINAL ticks,
+            # and those submits append in-flight wires — draining
+            # _inflight before the tick loop exits would strand (and
+            # silently drop) the last window's patches (proven by the
+            # pipeline shutdown/drain test)
+            await self.controller.stop()
+            if self._flush_task is not None:
+                self._flush_task.cancel()
+                self._flush_task = None
+            await self._drain_inflight()
+            # drop the registry entry so closed cores (and their device-
+            # resident bucket state) do not accumulate across loops
+            for k, v in list(FusedCore._instances.items()):
+                if v is self:
+                    del FusedCore._instances[k]
+        finally:
+            self._stop_done.set()
 
     # ------------------------------------------------------------ plumbing
 
@@ -864,9 +1039,12 @@ class FusedCore:
         for bucket in self.buckets.values():
             try:
                 submitted = bucket.submit()
-            except Exception:
-                # surface loudly: a submit failure (bad sharding, device
-                # error) otherwise dies as 5 silent INFO-level retries
+            except Exception as err:  # noqa: BLE001 — degraded-mode gate
+                if self._recover_step_failure(bucket, err):
+                    continue
+                # surface loudly: a row-independent submit failure (bad
+                # sharding, systemic device error) otherwise dies as 5
+                # silent INFO-level retries
                 log.exception("fused-core: bucket submit failed "
                               "(B=%d S=%d mesh=%s)", bucket.B, bucket.S,
                               bucket.mesh is not None)
@@ -907,6 +1085,70 @@ class FusedCore:
         if self._inflight:
             self._schedule_flush()
         return []
+
+    # ------------------------------------------------ degraded-mode path
+
+    def _recover_step_failure(self, bucket: FusedBucket, err: Exception) -> bool:
+        """Survive a failed device step without stalling the bucket's
+        co-tenants: retry once wholesale (full re-upload rebuilds the
+        resident state from the host mirrors — the source of truth), and
+        on a second consecutive failure bisect the submitted rows to
+        quarantine the poison. Returns False when the failure is
+        row-independent (the caller then propagates it)."""
+        bucket.stats["step_failures"] += 1
+        bucket._step_failures += 1
+        REGISTRY.counter(
+            "fused_step_failures_total",
+            "fused device-step submissions that raised").inc()
+        if bucket._step_failures == 1:
+            log.warning("fused-core: device step failed (%s: %s); retrying "
+                        "once with a full re-upload", type(err).__name__, err)
+            bucket.mark_stale()
+            self.controller.queue.add(("__retick__", False, id(bucket), None))
+            return True
+        suspects = list(bucket._last_rows)
+        bad = bucket.bisect_poison(suspects)
+        if bad is None:
+            # even the empty probe fails: systemic. Propagate — but keep
+            # the bucket dirty: the failed submit already consumed the
+            # staged events and cleared _stale, so without this the
+            # controller's retried items would find nothing to submit
+            # and the bucket would wedge converged-looking forever
+            bucket.mark_stale()
+            return False
+        for row in bad:
+            key, section = bucket.quarantine_row(row)
+            log.warning("fused-core: quarantined row %d (key=%r) after "
+                        "repeated device-step failures", row, key)
+            if key is not None and section is not None:
+                self._requeue_quarantined(section, key)
+        bucket._step_failures = 0
+        bucket.mark_stale()
+        self.controller.queue.add(("__retick__", False, id(bucket), None))
+        return True
+
+    def _requeue_quarantined(self, section: Section, key) -> None:
+        """Hand a quarantined key back to its owner after a bounded
+        exponential backoff — level-triggered recovery: if the poison was
+        transient the re-staged row converges; if not, the next failing
+        tick re-quarantines it at a longer (capped) delay."""
+        qk = (id(section), key)
+        n = self._quarantine_retries.get(qk, 0)
+        self._quarantine_retries[qk] = n + 1
+        delay = min(QUARANTINE_BASE_BACKOFF * (2 ** n), QUARANTINE_MAX_BACKOFF)
+        REGISTRY.counter(
+            "fused_quarantine_requeues_total",
+            "quarantined keys scheduled for an owner requeue").inc()
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return  # no loop (sync drivers): the next owner event recovers
+
+        def _requeue() -> None:
+            if not section.released:
+                self.enqueue(section, False, key)
+
+        loop.call_later(delay, _requeue)
 
     def _encode_section(self, section: Section, keymasks: dict) -> None:
         from ..ops.encode import BucketOverflow

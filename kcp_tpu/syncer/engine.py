@@ -305,7 +305,15 @@ class BatchSyncEngine:
     async def _apply_async(self, key, code: int, upsync: bool) -> bool:
         """Apply one verified decision. Override (or monkeypatch) to make
         applies genuinely asynchronous (e.g. thread-pooled REST calls) —
-        the tick loop never waits on this."""
+        the tick loop never waits on this. ``syncer.apply`` is a
+        KCP_FAULTS injection point (error -> the worker's normal
+        failure/backoff path; latency -> an awaited delay, so a slow
+        apply exercises the pending-dedup discipline, never the tick)."""
+        from .. import faults
+
+        delay = faults.maybe_fail("syncer.apply")
+        if delay:
+            await asyncio.sleep(delay)
         return self._apply_decision(key, code, upsync)
 
     def _apply_failed(self, key, code: int, upsync: bool, err: Exception) -> None:

@@ -47,6 +47,18 @@ class BadRequestError(ApiError):
     reason = "BadRequest"
 
 
+class UnavailableError(ApiError):
+    """A dependency is (temporarily) unreachable or refusing service:
+    injected 5xx faults, circuit-broken remote I/O, dead store backends.
+
+    Deliberately NOT a RetryableError: callers get the bounded workqueue
+    retry budget, and a new informer event resets it — an unavailable
+    dependency must degrade, not spin."""
+
+    code = 503
+    reason = "ServiceUnavailable"
+
+
 class RetryableError(Exception):
     """Marker wrapper: retry the operation without a bounded retry budget.
 

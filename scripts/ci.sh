@@ -30,6 +30,13 @@ make -s -C native kcptok.so
 echo "== tests: full suite, race-checked (KCP_RACE=1 via conftest)"
 python -m pytest tests/ -q
 
+echo "== chaos: seeded KCP_FAULTS smoke (store 5xx + one device-step raise)"
+# the spec grammar is documented in kcp_tpu/faults.py; the test asserts
+# tier-1 convergence with zero lost patches under the injected schedule
+KCP_FAULTS='store.put:error=0.05;device.step:raise@tick=5' \
+    KCP_FAULTS_SEED=1337 \
+    python -m pytest tests/test_faults.py::test_ci_chaos_smoke -q
+
 echo "== bench: CPU smoke of the serial-vs-pipelined tick A/B (tiny shape)"
 ab_line=$(JAX_PLATFORMS=cpu KCP_BENCH_CHILD=1 KCP_BENCH_ROWS=2048 \
     KCP_BENCH_CHURN=64 KCP_BENCH_WARMUP=6 KCP_BENCH_SEGMENTS=1 \
