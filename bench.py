@@ -89,10 +89,17 @@ SEGMENTS = int(os.environ.get("KCP_BENCH_SEGMENTS", "3"))
 STALL_S = 45.0  # no tick progress for this long => wedged device, abort
 
 # orchestrator budget: 3 attempts x 240s + 2 short backoffs ~= 13.5 min,
-# inside the ~20 min driver window demonstrated by the r03 record
-CHILD_TIMEOUT_S = 240
+# inside the ~20 min driver window demonstrated by the r03 record.
+# KCP_BENCH_CHILD_TIMEOUT shrinks the per-attempt window where the
+# failure mode is known-fast (and lets the degraded-fallback loop be
+# exercised in minutes, not a full driver window).
+CHILD_TIMEOUT_S = float(os.environ.get("KCP_BENCH_CHILD_TIMEOUT", "240"))
 CHILD_GRACE_S = 25  # child hard-exits this long before the orchestrator kill
-INIT_STALL_S = 110  # device init not done by then => report + exit early
+# device init not done by then => report + exit early. Overridable
+# (KCP_BENCH_DEVICE_TIMEOUT) because the right budget is host-specific:
+# r05 burned all three attempts on a tunnel that needed a few seconds
+# more than the default and published value=0 for the whole round.
+INIT_STALL_S = float(os.environ.get("KCP_BENCH_DEVICE_TIMEOUT", "110"))
 CHILD_ATTEMPTS = 3
 ATTEMPT_BACKOFFS_S = (20, 30)
 DEADLINE_ENV = "KCP_BENCH_DEADLINE"  # unix time the orchestrator kills at
@@ -662,6 +669,89 @@ def suite() -> int:
     os._exit(0)
 
 
+def store_bench() -> int:
+    """BASELINE configs[4] host-side scenario: 100k-object list + watch
+    fan-out against C selector-bound watches, A/B across the indexed
+    (KCP_STORE_INDEX=1, CoW + batched fan-out) and legacy (linear scan +
+    per-event deepcopy) store read paths. Pure host — no device, no
+    orchestrator; one JSON line with the combined speedup as the value.
+    """
+    from kcp_tpu.store.selectors import parse_selector
+    from kcp_tpu.store.store import LogicalStore
+
+    n_objects = int(os.environ.get("KCP_BENCH_STORE_OBJECTS", "100000"))
+    n_watches = int(os.environ.get("KCP_BENCH_STORE_WATCHES", "64"))
+    n_lists = int(os.environ.get("KCP_BENCH_STORE_LISTS", "3"))
+    n_muts = int(os.environ.get("KCP_BENCH_STORE_MUTS", "2000"))
+    teams = [f"t{i}" for i in range(n_watches)]
+    clusters = [f"c{i}" for i in range(16)]
+    namespaces = [f"ns{i}" for i in range(8)]
+
+    def run(indexed: bool) -> dict:
+        s = LogicalStore(indexed=indexed)
+        rng = np.random.default_rng(11)
+        for i in range(n_objects):
+            s.create("configmaps", clusters[i % 16], {
+                "apiVersion": "v1", "kind": "ConfigMap",
+                "metadata": {"name": f"cm-{i}",
+                             "namespace": namespaces[i % 8],
+                             "labels": {"team": teams[i % n_watches],
+                                        "tier": str(i % 7)}},
+                "data": {"v": str(i)},
+            })
+        watches = [s.watch("configmaps", selector=parse_selector(f"team={t}"))
+                   for t in teams]
+
+        t0 = time.perf_counter()
+        for _ in range(n_lists):
+            items, _rv = s.list("configmaps")
+            assert len(items) == n_objects
+            items, _rv = s.list("configmaps", clusters[0], namespaces[0])
+        t_list = time.perf_counter() - t0
+
+        events = 0
+        t0 = time.perf_counter()
+        for m in range(n_muts):
+            i = int(rng.integers(n_objects))
+            # every 8th mutation flips the team label — the selector-bound
+            # ADDED/DELETED rewrite path, not just the match
+            team = teams[(i + m) % n_watches] if m % 8 == 0 else teams[i % n_watches]
+            s.update("configmaps", clusters[i % 16], {
+                "apiVersion": "v1", "kind": "ConfigMap",
+                "metadata": {"name": f"cm-{i}",
+                             "namespace": namespaces[i % 8],
+                             "labels": {"team": team, "tier": str(i % 7)}},
+                "data": {"v": f"m{m}"},
+            })
+            if m % 128 == 127:
+                events += sum(len(w.drain()) for w in watches)
+        events += sum(len(w.drain()) for w in watches)
+        t_fanout = time.perf_counter() - t0
+        s.close()
+        return {"list_s": round(t_list, 4), "fanout_s": round(t_fanout, 4),
+                "events": events}
+
+    legacy = run(False)
+    indexed = run(True)
+    combined = (legacy["list_s"] + legacy["fanout_s"]) / max(
+        indexed["list_s"] + indexed["fanout_s"], 1e-9)
+    out = {
+        "metric": "store_read_path_speedup",
+        "value": round(combined, 2),
+        "unit": "x",
+        "store_bench": {
+            "objects": n_objects, "watches": n_watches,
+            "lists": n_lists, "mutations": n_muts,
+            "list_speedup": round(legacy["list_s"] / max(indexed["list_s"], 1e-9), 2),
+            "fanout_speedup": round(legacy["fanout_s"] / max(indexed["fanout_s"], 1e-9), 2),
+            "events_equal": legacy["events"] == indexed["events"],
+            "indexed": indexed, "legacy": legacy,
+        },
+    }
+    emit(out)
+    return 0
+
+
 # ---------------------------------------------------------------------------
 # Orchestrator: the TPU rides a tunnel that wedges transiently, and a hung
 # in-process backend init cannot be interrupted from within. So the default
@@ -804,12 +894,53 @@ def orchestrate(child_args: list[str]) -> int:
     if best is not None:
         print(json.dumps(best))
         return 0
+    # every device attempt died without evidence (r05: three
+    # device-init stalls published value=0 and the round went blind).
+    # Run once more on the CPU backend: a real number tagged degraded
+    # keeps the perf trajectory measurable even when the accelerator
+    # path is down — the tag (not the value) is the alarm.
+    print("all device attempts failed; running CPU-backend fallback",
+          file=sys.stderr)
+    env = dict(os.environ, KCP_BENCH_CHILD="1", JAX_PLATFORMS="cpu",
+               KCP_BENCH_FINAL="1")
+    env[DEADLINE_ENV] = str(time.time() + CHILD_TIMEOUT_S)
+    with tempfile.TemporaryFile(mode="w+") as outf, \
+            tempfile.TemporaryFile(mode="w+") as errf:
+        try:
+            subprocess.run(
+                [sys.executable, os.path.abspath(__file__), *child_args],
+                env=env, stdout=outf, stderr=errf, text=True,
+                timeout=CHILD_TIMEOUT_S,
+            )
+        except subprocess.TimeoutExpired:
+            pass
+        outf.seek(0)
+        salvaged, _diag = _salvage(outf.read(), for_suite)
+        errf.seek(0)
+        sys.stderr.write(errf.read())
+    if salvaged is not None:
+        salvaged["degraded"] = True
+        salvaged["note"] = (salvaged.get("note", "") + " [device unavailable "
+                            "after " + str(CHILD_ATTEMPTS) + " attempts; "
+                            "CPU-backend fallback measurement]").strip()
+        print(json.dumps(salvaged))
+        return 0
     _fail_json("measurement", last, CHILD_ATTEMPTS, for_suite)
     return 0
 
 
 if __name__ == "__main__":
     args = [a for a in sys.argv[1:] if a != "--child"]
+    if "--store" in args:
+        # pure-host store microbench: pin CPU (never touch the tunnel)
+        # and run in-process — no watchdog child needed
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+        sys.exit(store_bench())
     if "--probe" in args:
         # manual diagnostic: always run in-process (never through the
         # orchestrator, whose JSON contract a probe's output would fail)

@@ -202,7 +202,17 @@ class Informer:
             if key not in fresh:
                 self._apply(DELETED, old)
         for key, obj in fresh.items():
-            self._apply(MODIFIED if key in self.cache else ADDED, obj)
+            old = self.cache.get(key)
+            if old is not None:
+                old_rv = old["metadata"].get("resourceVersion")
+                if old_rv is not None and old_rv == obj["metadata"].get("resourceVersion"):
+                    # unchanged since the last observation: nothing was
+                    # missed for this key, so skip the MODIFIED fan-out
+                    # (a relist after a dropped stream would otherwise
+                    # wake every controller for the whole cache)
+                    self.cache[key] = obj
+                    continue
+            self._apply(MODIFIED if old is not None else ADDED, obj)
         return rv
 
     def _dispatch(self, ev: Event) -> None:

@@ -16,11 +16,20 @@ which uniformly covers =, !=, in, notin, exists, !exists (Kubernetes
 semantics: != and notin are satisfied by absence; label keys are unique
 per object so pair-presence == key-equals-value).
 
-Two paths:
-- :func:`match_batch` — general: N objects x 1 compiled selector
+Three paths:
+- :func:`match_batch` — general: N objects x 1 compiled selector (device)
 - :func:`fanout_match` — N objects x C single-pair selectors (the syncer
   fan-out shape, one ``kcp.dev/cluster=<id>`` per cluster) as one
-  [N, C] compare reduce
+  [N, C] compare reduce (device)
+- :func:`match_batch_np` / :func:`fanout_match_np` — numpy twins of the
+  same kernels for host-side consumers (the store's batched watch
+  fan-out) where a device round trip per micro-batch would cost more
+  than it saves
+
+The hash functions are pluggable: the device path uses the 32-bit FNV
+hashes (collision-tolerant — the syncer re-verifies on the host before
+every write), while the store's exact fan-out passes interned label ids
+so two distinct pairs can never alias.
 """
 
 from __future__ import annotations
@@ -49,7 +58,17 @@ class CompiledSelector:
         return int(self.alts.shape[0])
 
 
-def compile_selector(sel: LabelSelector, max_reqs: int = 8, max_alts: int = 8) -> CompiledSelector:
+def compile_selector(
+    sel: LabelSelector,
+    max_reqs: int = 8,
+    max_alts: int = 8,
+    pair_hash=hash_pair,
+    key_hash=hash_key,
+) -> CompiledSelector:
+    """Compile to the [R, V] kernel shape; raises ValueError when the
+    selector exceeds it. ``pair_hash``/``key_hash`` default to the 32-bit
+    FNV hashes the device kernels consume; exact host-side consumers pass
+    interning functions instead (ids must be nonzero uint32)."""
     reqs = sel.requirements
     if len(reqs) > max_reqs:
         raise ValueError(f"selector has {len(reqs)} requirements (max {max_reqs})")
@@ -60,23 +79,49 @@ def compile_selector(sel: LabelSelector, max_reqs: int = 8, max_alts: int = 8) -
     for i, r in enumerate(reqs):
         valid[i] = True
         if r.op in ("=", "in"):
-            hashes = [hash_pair(r.key, v) for v in r.values]
+            hashes = [pair_hash(r.key, v) for v in r.values]
         elif r.op in ("!=", "notin"):
             negate[i] = True
-            hashes = [hash_pair(r.key, v) for v in r.values]
+            hashes = [pair_hash(r.key, v) for v in r.values]
         elif r.op == "exists":
             use_key[i] = True
-            hashes = [hash_key(r.key)]
+            hashes = [key_hash(r.key)]
         elif r.op == "!exists":
             negate[i] = True
             use_key[i] = True
-            hashes = [hash_key(r.key)]
+            hashes = [key_hash(r.key)]
         else:
             raise ValueError(f"unknown op {r.op!r}")
         if len(hashes) > max_alts:
             raise ValueError(f"requirement on {r.key!r} has {len(hashes)} values (max {max_alts})")
         alts[i, : len(hashes)] = hashes
     return CompiledSelector(alts, negate, use_key, valid)
+
+
+def try_compile_selector(
+    sel: LabelSelector,
+    max_reqs: int = 8,
+    max_alts: int = 8,
+    pair_hash=hash_pair,
+    key_hash=hash_key,
+) -> CompiledSelector | None:
+    """:func:`compile_selector`, but a selector that exceeds the [R, V]
+    kernel shape returns None (counted in ``labelmatch_fallback_total``)
+    so callers fall back to host-path matching instead of erroring out —
+    an oversized selector is a valid request, just not a kernel-shaped
+    one. Unknown operators still raise."""
+    reqs = sel.requirements
+    oversized = len(reqs) > max_reqs or any(
+        len(r.values) > max_alts for r in reqs)
+    if oversized:
+        from ..utils.trace import REGISTRY
+
+        REGISTRY.counter(
+            "labelmatch_fallback_total",
+            "selectors too large for the match kernel, matched host-side",
+        ).inc()
+        return None
+    return compile_selector(sel, max_reqs, max_alts, pair_hash, key_hash)
 
 
 def match_batch(
@@ -113,6 +158,27 @@ def fanout_match(pair_hashes: jax.Array, selector_hashes: jax.Array) -> jax.Arra
 
 
 fanout_match_jit = jax.jit(fanout_match)
+
+
+def match_batch_np(
+    pair_hashes: np.ndarray,  # uint32 [N, L]
+    key_hashes: np.ndarray,  # uint32 [N, L]
+    cs: CompiledSelector,
+) -> np.ndarray:
+    """Numpy twin of :func:`match_batch`: bool [N], no device round trip.
+
+    The store's watch fan-out runs this per micro-batch — tens to
+    hundreds of rows, where a transfer would dominate the compare."""
+    table = np.where(cs.use_key[:, None, None], key_hashes[None], pair_hashes[None])  # [R,N,L]
+    eq = table[:, :, :, None] == cs.alts[:, None, None, :]  # [R,N,L,V]
+    contains = (eq & (cs.alts != 0)[:, None, None, :]).any(axis=(2, 3))  # [R,N]
+    satisfied = np.logical_xor(contains, cs.negate[:, None]) | ~cs.valid[:, None]
+    return satisfied.all(axis=0)
+
+
+def fanout_match_np(pair_hashes: np.ndarray, selector_hashes: np.ndarray) -> np.ndarray:
+    """Numpy twin of :func:`fanout_match`: bool [N, C]."""
+    return (pair_hashes[:, None, :] == selector_hashes[None, :, None]).any(axis=-1)
 
 
 def match_host(sel: LabelSelector, labels_list: list[dict | None]) -> np.ndarray:

@@ -441,8 +441,12 @@ class RestHandler:
             # (this same scan, against its in-memory index) — forwarding
             # the wildcard costs one round trip instead of tenants+1
             return cluster
-        matches = [c for c in self.store.clusters()
-                   if self._exists(res, c, name, namespace)]
+        if hasattr(self.store, "locate"):
+            # index-driven: only clusters holding the resource are probed
+            matches = self.store.locate(res, name, namespace)
+        else:
+            matches = [c for c in self.store.clusters()
+                       if self._exists(res, c, name, namespace)]
         if len(matches) == 1:
             return matches[0]
         if not matches:
@@ -566,7 +570,20 @@ class RestHandler:
                         continue
                     except StopAsyncIteration:
                         return
-                    await stream.send_json({"type": ev.type, "object": ev.object})
+                    # coalesce whatever else the watch already buffered
+                    # (the store's batched fan-out delivers in bursts)
+                    # into one chunk/one drain instead of a write per
+                    # event; drain() never raises, so error mapping above
+                    # is unaffected. Streams without the batch method
+                    # (test fakes/duck types) get the per-event sends.
+                    batch = [ev, *watch.drain()]
+                    send_many = getattr(stream, "send_json_many", None)
+                    if send_many is not None:
+                        await send_many(
+                            [{"type": e.type, "object": e.object} for e in batch])
+                    else:
+                        for e in batch:
+                            await stream.send_json({"type": e.type, "object": e.object})
             finally:
                 watch.close()
 

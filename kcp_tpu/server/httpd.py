@@ -83,6 +83,19 @@ class StreamResponse:
         self._writer.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
         await self._writer.drain()
 
+    async def send_json_many(self, objs) -> None:
+        """All objects as ndjson lines in ONE chunk + one drain — the
+        watch relay's wire-level fan-out batching. Clients reassemble by
+        newline (RestWatch already splits chunk payloads on ``\\n``), so
+        framing is unchanged; a burst of N events costs one syscall
+        instead of N."""
+        assert self._writer is not None
+        if not objs:
+            return
+        data = b"".join(json.dumps(o).encode() + b"\n" for o in objs)
+        self._writer.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+        await self._writer.drain()
+
     async def _finish(self) -> None:
         if self._writer is not None:
             try:

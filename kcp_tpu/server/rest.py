@@ -131,9 +131,13 @@ class RestWatch:
                     break
                 chunk = await reader.readexactly(size)
                 await reader.readexactly(2)  # trailing \r\n
-                buf += chunk
-                while b"\n" in buf:
-                    line, buf = buf.split(b"\n", 1)
+                # one split per chunk: the server's relay batches event
+                # bursts into multi-line chunks (send_json_many), and the
+                # old split-one-line-at-a-time loop rescanned the buffer
+                # per line
+                lines = (buf + chunk).split(b"\n")
+                buf = lines.pop()  # partial trailing line (usually empty)
+                for line in lines:
                     if line.strip():
                         self._handle_line(json.loads(line))
         except (ConnectionError, asyncio.IncompleteReadError, OSError,

@@ -59,6 +59,19 @@ class LabelSelector:
     def empty(self) -> bool:
         return not self.requirements
 
+    @property
+    def single_equality(self) -> tuple[str, str] | None:
+        """(key, value) when this is exactly one ``=`` requirement — the
+        dominant watch shape (the syncer registers one
+        ``kcp.dev/cluster=<id>`` per cluster) and the one the batched
+        fan-out can answer with a single pair-presence compare
+        (ops/labelmatch.fanout_match)."""
+        if len(self.requirements) == 1:
+            r = self.requirements[0]
+            if r.op == "=" and len(r.values) == 1:
+                return (r.key, r.values[0])
+        return None
+
     def __str__(self) -> str:
         parts = []
         for r in self.requirements:

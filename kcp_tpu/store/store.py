@@ -25,6 +25,29 @@ kcp-dev/kubernetes fork is not vendored there):
   compaction (restart resumes from durable storage, matching the
   reference's restart-resumes-from-etcd model, server.go:80-97)
 
+Read path (KCP_STORE_INDEX=1, the default):
+
+- secondary ``resource -> cluster -> namespace`` buckets are maintained
+  on every mutation (and rebuilt on WAL/snapshot restore), so ``list``
+  touches only candidate keys instead of every object in the process;
+- copy-on-write objects: stored snapshots are never mutated in place
+  (every write replaces the whole dict), so ``list`` results and watch
+  ``Event`` objects share references with the store and the deep copy
+  is deferred to the mutation boundary — callers treat listed objects
+  and event payloads as frozen and re-``get`` (or deepcopy) before
+  editing, exactly like client-go informer caches;
+- watch fan-out is batched: ``_emit`` coalesces events into
+  micro-batches and matches each batch against all registered watch
+  selectors in one vectorized pass (ops/labelmatch host twins over
+  interned label ids — exact, no hash collisions), preserving the
+  old-match/new-match ADDED/MODIFIED/DELETED rewrite semantics of
+  :meth:`Watch._transform`. Batches flush at the asyncio loop boundary
+  (``call_soon``), on a size threshold, and lazily whenever a consumer
+  touches a watch, so delivery semantics are unchanged.
+
+``KCP_STORE_INDEX=0`` (or ``indexed=False``) keeps the pre-index scan +
+per-event deepcopy path for A/B measurement (``bench.py --store``).
+
 Thread-model: single-threaded synchronous core intended to be called from
 one asyncio event loop; watches buffer into deques and optionally notify an
 asyncio.Event so async consumers can await new events.
@@ -42,6 +65,8 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Iterator, Mapping
 
+import numpy as np
+
 from ..faults import maybe_fail, should_drop
 from ..utils.errors import (
     AlreadyExistsError,
@@ -49,9 +74,14 @@ from ..utils.errors import (
     InvalidError,
     NotFoundError,
 )
+from ..utils.trace import REGISTRY, SIZE_BUCKETS
 from .selectors import LabelSelector, everything
 
 WILDCARD = "*"
+
+
+def _env_indexed() -> bool:
+    return os.environ.get("KCP_STORE_INDEX", "1").lower() not in ("0", "false", "off")
 
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
@@ -99,6 +129,12 @@ class Watch:
         self._events: deque[Event] = deque()
         self._closed = False
         self._wakeup: asyncio.Event | None = None
+        # batched fan-out (indexed stores): a single-equality selector
+        # matches via one interned pair id (the fanout_match shape), a
+        # general kernel-shaped one via a CompiledSelector; both None =>
+        # exact per-event python matching (_transform)
+        self._eq_pid: int | None = None
+        self._compiled = None
 
     def _scope_match(self, ev: Event) -> bool:
         if ev.resource != self.resource:
@@ -155,6 +191,7 @@ class Watch:
 
     def drain(self) -> list[Event]:
         """Return and clear all buffered events (sync consumers/tests)."""
+        self._store._flush_events()
         out = list(self._events)
         self._events.clear()
         if self._wakeup is not None:
@@ -162,10 +199,15 @@ class Watch:
         return out
 
     def pending(self) -> int:
+        self._store._flush_events()
         return len(self._events)
 
     def close(self) -> None:
         if not self._closed:
+            # deliver what was emitted before the close — with deferred
+            # fan-out, an event committed pre-close must still land in
+            # this watch's buffer (legacy _emit delivered synchronously)
+            self._store._flush_events()
             self._closed = True
             self._store._unsubscribe(self)
             if self._wakeup is not None:
@@ -180,6 +222,7 @@ class Watch:
 
     async def __anext__(self) -> Event:
         while True:
+            self._store._flush_events()
             if self._events:
                 return self._events.popleft()
             if self._closed:
@@ -195,6 +238,7 @@ class Watch:
         The batching primitive for the TPU backend: the reconcile tick
         collects a delta batch instead of handling events one at a time.
         """
+        self._store._flush_events()
         if not self._events and not self._closed:
             if self._wakeup is None:
                 self._wakeup = asyncio.Event()
@@ -267,8 +311,13 @@ class LogicalStore:
         wal_backend: str = "auto",
         wal_sync_every: int = 256,
         namespace_lifecycle: bool = False,
+        indexed: bool | None = None,
     ):
-        """``wal_backend``: "auto" uses the native C++ engine
+        """``indexed``: None reads ``KCP_STORE_INDEX`` (default on) —
+        False keeps the pre-index linear-scan/deepcopy read path and the
+        per-watch python fan-out for A/B measurement.
+
+        ``wal_backend``: "auto" uses the native C++ engine
         (native/walstore.cc — binary records, CRC32 torn-write recovery,
         batched fsync) when the library loads, else the JSON-lines
         fallback; "native"/"json" force a choice.
@@ -297,6 +346,24 @@ class LogicalStore:
         self._watches: list[Watch] = []
         self._history: deque[Event] = deque(maxlen=200_000)
         self._clock = clock
+        self._indexed = _env_indexed() if indexed is None else bool(indexed)
+        # secondary index: resource -> cluster -> namespace -> {key: obj};
+        # maintained on every mutation (both modes — clusters()/
+        # resources()/locate() read it), pruned empty so the bucket keys
+        # are exactly the live (resource, cluster, namespace) triples
+        self._buckets: dict[str, dict[str, dict[str, dict[Key, dict]]]] = {}
+        # batched watch fan-out (indexed mode)
+        self._pending: list[Event] = []
+        self._flush_scheduled = False
+        self._flushing = False
+        self._emit_batch = max(1, int(os.environ.get("KCP_STORE_EMIT_BATCH", "128")))
+        # exact label interning for the vectorized matchers: distinct
+        # (key, value) pairs / keys get sequential nonzero uint32 ids, so
+        # unlike the device kernels' 32-bit hashes two labels can never
+        # alias — watch semantics stay byte-identical to _transform
+        self._intern_pairs: dict = {}
+        self._intern_keys: dict[str, int] = {}
+        self._labelmatch = None  # lazy ops.labelmatch module (pulls jax)
         self._wal: _WalConfig | None = None
         self._engine = None
         self._engine_mutations = 0
@@ -369,6 +436,45 @@ class LogicalStore:
     def _now(self) -> str:
         return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(self._clock()))
 
+    # ------------------------------------------------------------- index
+
+    def _put_obj(self, key: Key, obj: dict) -> None:
+        """Insert/replace an object in the map and the secondary index."""
+        self._objects[key] = obj
+        r, c, n, _ = key
+        self._buckets.setdefault(r, {}).setdefault(c, {}).setdefault(n, {})[key] = obj
+
+    def _del_obj(self, key: Key) -> None:
+        self._objects.pop(key, None)
+        r, c, n, _ = key
+        res = self._buckets.get(r)
+        if res is None:
+            return
+        cl = res.get(c)
+        if cl is None:
+            return
+        ns = cl.get(n)
+        if ns is None:
+            return
+        ns.pop(key, None)
+        if not ns:
+            del cl[n]
+            if not cl:
+                del res[c]
+                if not res:
+                    del self._buckets[r]
+
+    def locate(self, resource: str, name: str, namespace: str = "") -> list[str]:
+        """Clusters holding (resource, namespace, name) — the index-driven
+        answer to wildcard single-object reads (server.handler scans
+        tenants for the unique owner)."""
+        ns = namespace or ""
+        out = []
+        for c, nss in self._buckets.get(resource, {}).items():
+            if (resource, c, ns, name) in nss.get(ns, ()):
+                out.append(c)
+        return sorted(out)
+
     # --------------------------------------------------------------- CRUD
 
     def create(self, resource: str, cluster: str, obj: dict, namespace: str = "") -> dict:
@@ -402,7 +508,7 @@ class LogicalStore:
         meta["generation"] = 1
         rv = self._next_rv()
         meta["resourceVersion"] = str(rv)
-        self._objects[key] = obj
+        self._put_obj(key, obj)
         self._emit(ADDED, key, obj, rv)
         self._log_wal({"op": "put", "key": list(key), "obj": obj, "rv": rv})
         return copy.deepcopy(obj)
@@ -473,11 +579,11 @@ class LogicalStore:
         new_meta["generation"] = ex_meta.get("generation", 1) + (1 if spec_changed else 0)
         rv = self._next_rv()
         new_meta["resourceVersion"] = str(rv)
-        self._objects[key] = new_obj
+        self._put_obj(key, new_obj)
 
         # finalizer-driven deletion completion
         if new_meta.get("deletionTimestamp") and not new_meta.get("finalizers"):
-            del self._objects[key]
+            self._del_obj(key)
             self._emit(DELETED, key, new_obj, rv, old=existing)
             self._log_wal({"op": "del", "key": list(key), "rv": rv})
         else:
@@ -502,11 +608,11 @@ class LogicalStore:
                 obj["metadata"]["deletionTimestamp"] = self._now()
                 rv = self._next_rv()
                 obj["metadata"]["resourceVersion"] = str(rv)
-                self._objects[key] = obj
+                self._put_obj(key, obj)
                 self._emit(MODIFIED, key, obj, rv, old=existing)
                 self._log_wal({"op": "put", "key": list(key), "obj": obj, "rv": rv})
             return
-        del self._objects[key]
+        self._del_obj(key)
         rv = self._next_rv()
         self._emit(DELETED, key, existing, rv, old=existing)
         self._log_wal({"op": "del", "key": list(key), "rv": rv})
@@ -520,33 +626,81 @@ class LogicalStore:
         namespace: str | None = None,
         selector: LabelSelector | None = None,
     ) -> tuple[list[dict], int]:
-        """Return (items, list resourceVersion)."""
+        """Return (items, list resourceVersion).
+
+        Indexed mode walks only the (resource, cluster, namespace)
+        candidate buckets and returns shared references (CoW contract:
+        callers must not mutate items — re-``get`` or deepcopy before
+        editing). Legacy mode is the pre-index O(total-objects) scan
+        with a deepcopy per match.
+        """
         _inject("store.list")
         selector = selector or everything()
-        out = []
-        for (res, cl, ns, _name), obj in self._objects.items():
-            if res != resource:
-                continue
-            if cluster != WILDCARD and cl != cluster:
-                continue
-            if namespace is not None and ns != namespace:
-                continue
-            labels = (obj.get("metadata") or {}).get("labels") or {}
-            if not selector.matches(labels):
-                continue
-            out.append(copy.deepcopy(obj))
-        out.sort(key=lambda o: (o["metadata"].get("clusterName", ""),
-                                o["metadata"].get("namespace", ""),
-                                o["metadata"]["name"]))
+        if not self._indexed:
+            out = []
+            for (res, cl, ns, _name), obj in self._objects.items():
+                if res != resource:
+                    continue
+                if cluster != WILDCARD and cl != cluster:
+                    continue
+                if namespace is not None and ns != namespace:
+                    continue
+                labels = (obj.get("metadata") or {}).get("labels") or {}
+                if not selector.matches(labels):
+                    continue
+                out.append(copy.deepcopy(obj))
+            out.sort(key=lambda o: (o["metadata"].get("clusterName", ""),
+                                    o["metadata"].get("namespace", ""),
+                                    o["metadata"]["name"]))
+            self._list_metrics(len(self._objects), len(out))
+            return out, self._rv
+
+        scanned = 0
+        pairs: list[tuple[Key, dict]] = []
+        res_b = self._buckets.get(resource)
+        if res_b:
+            if cluster != WILDCARD:
+                cl_bs = [res_b[cluster]] if cluster in res_b else []
+            else:
+                cl_bs = list(res_b.values())
+            empty = selector.empty
+            for cl_b in cl_bs:
+                if namespace is not None:
+                    ns_bs = [cl_b[namespace]] if namespace in cl_b else []
+                else:
+                    ns_bs = list(cl_b.values())
+                for ns_b in ns_bs:
+                    scanned += len(ns_b)
+                    if empty:
+                        pairs.extend(ns_b.items())
+                    else:
+                        for key, obj in ns_b.items():
+                            labels = (obj.get("metadata") or {}).get("labels") or {}
+                            if selector.matches(labels):
+                                pairs.append((key, obj))
+        # key order == metadata (clusterName, namespace, name) order: the
+        # key IS the metadata triple (resource is constant here and keys
+        # are unique, so the dicts never get compared), and the bare
+        # tuple sort stays in C — no per-element key lambda
+        pairs.sort()
+        out = [obj for _, obj in pairs]
+        self._list_metrics(scanned, len(out))
         return out, self._rv
+
+    @staticmethod
+    def _list_metrics(scanned: int, returned: int) -> None:
+        REGISTRY.counter("store_list_scanned_total",
+                         "objects examined by store list scans").inc(scanned)
+        REGISTRY.counter("store_list_returned_total",
+                         "objects returned by store lists").inc(returned)
 
     def resources(self) -> list[str]:
         """Distinct resource names present in the store."""
-        return sorted({k[0] for k in self._objects})
+        return sorted(self._buckets)
 
     def clusters(self) -> list[str]:
         """Distinct logical-cluster names present in the store."""
-        return sorted({k[1] for k in self._objects})
+        return sorted({c for res in self._buckets.values() for c in res})
 
     def __len__(self) -> int:
         return len(self._objects)
@@ -562,7 +716,13 @@ class LogicalStore:
         since_rv: int | None = None,
     ) -> Watch:
         """Subscribe. With ``since_rv``, replays retained history > since_rv."""
+        # flush before subscribing: pending events predate this watch and
+        # must not be delivered live (the since_rv replay below covers
+        # them from history when asked to)
+        self._flush_events()
         w = Watch(self, resource, cluster, namespace, selector or everything())
+        if self._indexed and not w.selector.empty:
+            self._subscribe_selector(w)
         if since_rv is not None and since_rv < self._rv:
             # the retained history must cover (since_rv, now]; otherwise the
             # caller missed events it can never recover (e.g. resuming a
@@ -572,26 +732,238 @@ class LogicalStore:
                 raise ConflictError(
                     f"watch window expired: requested rv {since_rv}, oldest retained {oldest}"
                 )
-            for ev in self._history:
-                if ev.rv > since_rv:
-                    out = w._transform(ev)
-                    if out is not None:
-                        w._push(out)
+            # reversed tail-scan: resume RVs are recent (informers resume
+            # from where their stream dropped), so walk back from the end
+            # and replay the suffix — O(events replayed), instead of
+            # scanning the whole 200k-event retention from the front
+            tail: list[Event] = []
+            for ev in reversed(self._history):
+                if ev.rv <= since_rv:
+                    break
+                tail.append(ev)
+            for ev in reversed(tail):
+                out = w._transform(ev)
+                if out is not None:
+                    w._push(out)
         self._watches.append(w)
         return w
 
     def _emit(self, etype: str, key: Key, obj: dict, rv: int, old: dict | None = None) -> None:
-        ev = Event(
-            etype, key[0], key[1], key[2], key[3], copy.deepcopy(obj), rv,
-            copy.deepcopy(old) if old is not None else None,
-        )
+        if not self._indexed:
+            ev = Event(
+                etype, key[0], key[1], key[2], key[3], copy.deepcopy(obj), rv,
+                copy.deepcopy(old) if old is not None else None,
+            )
+            self._history.append(ev)
+            # snapshot: an injected watch drop closes (and unsubscribes)
+            # the watch from inside _push, mid-iteration
+            for w in list(self._watches):
+                out = w._transform(ev)
+                if out is not None:
+                    w._push(out)
+            return
+        # CoW: stored snapshots are never mutated in place (every write
+        # replaces the whole dict), so the event shares them — the
+        # per-event double deepcopy of the legacy path is gone
+        ev = Event(etype, key[0], key[1], key[2], key[3], obj, rv, old)
         self._history.append(ev)
-        # snapshot: an injected watch drop closes (and unsubscribes) the
-        # watch from inside _push, mid-iteration
-        for w in list(self._watches):
-            out = w._transform(ev)
-            if out is not None:
-                w._push(out)
+        self._pending.append(ev)
+        if len(self._pending) >= self._emit_batch:
+            self._flush_events()
+        elif not self._flush_scheduled:
+            try:
+                loop = asyncio.get_running_loop()
+            except RuntimeError:
+                return  # sync context: consumers flush lazily on access
+            self._flush_scheduled = True
+            loop.call_soon(self._flush_events)
+
+    # ------------------------------------------------- batched fan-out
+
+    def _flush_events(self) -> None:
+        """Deliver pending events to all watches in one vectorized pass.
+
+        Reentrancy-safe: an injected watch drop closes a watch from
+        inside delivery, and close() itself flushes first.
+        """
+        self._flush_scheduled = False
+        if self._flushing or not self._pending:
+            return
+        batch, self._pending = self._pending, []
+        self._flushing = True
+        t0 = time.perf_counter()
+        try:
+            self._fanout(batch)
+        finally:
+            self._flushing = False
+            REGISTRY.histogram("watch_fanout_batch_size",
+                               "events coalesced per watch fan-out pass",
+                               buckets=SIZE_BUCKETS).observe(len(batch))
+            REGISTRY.histogram("store_emit_seconds",
+                               "time delivering one fan-out batch").observe(
+                time.perf_counter() - t0)
+
+    def _fanout(self, batch: list[Event]) -> None:
+        if not self._watches:
+            return
+        by_res: dict[str, list[Event]] = {}
+        for ev in batch:
+            by_res.setdefault(ev.resource, []).append(ev)
+        w_by_res: dict[str, list[Watch]] = {}
+        for w in self._watches:
+            w_by_res.setdefault(w.resource, []).append(w)
+        for res, evs in by_res.items():
+            ws = [w for w in w_by_res.get(res, ()) if not w._closed]
+            if ws:
+                self._fanout_resource(evs, ws)
+
+    def _fanout_resource(self, evs: list[Event], ws: list[Watch]) -> None:
+        """One resource's events x that resource's watches, as matrices.
+
+        Selector matching is one vectorized pass over interned label ids:
+        single-equality selectors (the syncer shape) via fanout_match_np,
+        kernel-shaped ones via match_batch_np, oversized ones via the
+        exact per-event python path. Scope and the old-match/new-match
+        ADDED/MODIFIED/DELETED rewrite of :meth:`Watch._transform` are
+        then [N, C] boolean algebra; python touches only the (sparse)
+        deliveries.
+        """
+        n = len(evs)
+        fb_ws = [w for w in ws
+                 if not w.selector.empty and w._eq_pid is None and w._compiled is None]
+        mx_ws = [w for w in ws if w not in fb_ws]
+        if mx_ws:
+            c = len(mx_ws)
+            # scope[N, C]: cluster/namespace ids interned per batch;
+            # watch values absent from the batch get -1 (match nothing),
+            # wildcards -2 (match everything)
+            cmap: dict[str, int] = {}
+            nmap: dict[str, int] = {}
+            cl_ids = np.empty(n, np.int32)
+            ns_ids = np.empty(n, np.int32)
+            for i, ev in enumerate(evs):
+                cl_ids[i] = cmap.setdefault(ev.cluster, len(cmap))
+                ns_ids[i] = nmap.setdefault(ev.namespace, len(nmap))
+            w_cl = np.array([-2 if w.cluster == WILDCARD
+                             else cmap.get(w.cluster, -1) for w in mx_ws], np.int32)
+            w_ns = np.array([-2 if w.namespace is None
+                             else nmap.get(w.namespace, -1) for w in mx_ws], np.int32)
+            scope = ((w_cl[None, :] == -2) | (cl_ids[:, None] == w_cl[None, :])) \
+                & ((w_ns[None, :] == -2) | (ns_ids[:, None] == w_ns[None, :]))
+
+            is_add = np.fromiter((ev.type == ADDED for ev in evs), bool, n)
+            is_del = np.fromiter((ev.type == DELETED for ev in evs), bool, n)
+            is_mod = ~(is_add | is_del)
+
+            nm = np.zeros((n, c), bool)
+            om = np.zeros((n, c), bool)
+            eq_cols = [ci for ci, w in enumerate(mx_ws) if w._eq_pid is not None]
+            gen_cols = [ci for ci, w in enumerate(mx_ws) if w._compiled is not None]
+            if eq_cols or gen_cols:
+                from ..ops import labelmatch as lm
+
+                pair_new, key_new = self._encode_labels(evs, old=False)
+                pair_old, key_old = self._encode_labels(evs, old=True)
+                if eq_cols:
+                    sels = np.array([mx_ws[ci]._eq_pid for ci in eq_cols], np.uint32)
+                    nm[:, eq_cols] = lm.fanout_match_np(pair_new, sels)
+                    om[:, eq_cols] = lm.fanout_match_np(pair_old, sels)
+                for ci in gen_cols:
+                    cs = mx_ws[ci]._compiled
+                    nm[:, ci] = lm.match_batch_np(pair_new, key_new, cs)
+                    om[:, ci] = lm.match_batch_np(pair_old, key_old, cs)
+            for ci, w in enumerate(mx_ws):
+                if w.selector.empty:
+                    nm[:, ci] = om[:, ci] = True
+            nm &= ~is_del[:, None]  # _transform: new_match is False on DELETED
+
+            as_is = scope & ((is_add[:, None] & nm)
+                             | (is_del[:, None] & (om | nm))
+                             | (is_mod[:, None] & nm & om))
+            to_add = scope & is_mod[:, None] & nm & ~om
+            to_del = scope & is_mod[:, None] & ~nm & om
+            # argwhere is row-major: per-watch delivery stays in rv order
+            for ni, ci in np.argwhere(as_is | to_add | to_del):
+                w = mx_ws[ci]
+                if w._closed:
+                    continue
+                ev = evs[ni]
+                if as_is[ni, ci]:
+                    w._push(ev)
+                elif to_add[ni, ci]:
+                    w._push(Event(ADDED, ev.resource, ev.cluster, ev.namespace,
+                                  ev.name, ev.object, ev.rv, ev.old_object))
+                else:
+                    w._push(Event(DELETED, ev.resource, ev.cluster, ev.namespace,
+                                  ev.name, ev.object, ev.rv, ev.old_object))
+        for w in fb_ws:
+            # oversized selector: exact per-event fallback
+            for ev in evs:
+                if w._closed:
+                    break
+                out = w._transform(ev)
+                if out is not None:
+                    w._push(out)
+
+    def _encode_labels(self, evs: list[Event], old: bool) -> tuple[np.ndarray, np.ndarray]:
+        """Interned (pair ids, key ids), 0-padded to the batch's widest
+        label set — the host-twin encoding of ops/encode.encode_label_batch."""
+        labels_list = []
+        width = 1
+        for ev in evs:
+            obj = ev.old_object if old else ev.object
+            labels = ((obj or {}).get("metadata") or {}).get("labels") or {}
+            labels_list.append(labels)
+            width = max(width, len(labels))
+        pair = np.zeros((len(evs), width), np.uint32)
+        keyh = np.zeros((len(evs), width), np.uint32)
+        for i, labels in enumerate(labels_list):
+            for j, (k, v) in enumerate(labels.items()):
+                pair[i, j] = self._pid(k, v)
+                keyh[i, j] = self._kid(k)
+        return pair, keyh
+
+    @staticmethod
+    def _pair_token(k: str, v: Any):
+        """Intern-table key for a label pair. Strings (the k8s case) key
+        directly; non-string values get a type tag so e.g. 5 and "5"
+        (unequal to the python matcher) can never intern to one id, and
+        unhashable values fall back to their canonical JSON."""
+        if isinstance(v, str):
+            return (k, v)
+        try:
+            hash(v)
+        except TypeError:
+            return (k, "\x00json", json.dumps(v, sort_keys=True, default=str))
+        return (k, "\x00" + type(v).__name__, v)
+
+    def _pid(self, k: str, v: Any) -> int:
+        tok = self._pair_token(k, v)
+        i = self._intern_pairs.get(tok)
+        if i is None:
+            i = self._intern_pairs[tok] = len(self._intern_pairs) + 1
+        return i
+
+    def _kid(self, k: str) -> int:
+        i = self._intern_keys.get(k)
+        if i is None:
+            i = self._intern_keys[k] = len(self._intern_keys) + 1
+        return i
+
+    def _subscribe_selector(self, w: Watch) -> None:
+        """Compile a watch's selector for the vectorized fan-out."""
+        eq = w.selector.single_equality
+        if eq is not None:
+            w._eq_pid = self._pid(*eq)
+            return
+        if self._labelmatch is None:
+            from ..ops import labelmatch
+
+            self._labelmatch = labelmatch
+        # oversized selectors return None => exact per-event fallback
+        # (counted in labelmatch_fallback_total)
+        w._compiled = self._labelmatch.try_compile_selector(
+            w.selector, pair_hash=self._pid, key_hash=self._kid)
 
     def _unsubscribe(self, w: Watch) -> None:
         try:
@@ -628,7 +1000,7 @@ class LogicalStore:
         assert self._engine is not None
         for key, val in self._engine.scan():
             parts = tuple(key.decode("utf-8").split("\x00"))
-            self._objects[parts] = json.loads(val)
+            self._put_obj(parts, json.loads(val))
         self._rv = self._engine.rv
         # journal-only mode: this store holds the authoritative objects,
         # so the engine's duplicate value map would only double memory
@@ -642,7 +1014,7 @@ class LogicalStore:
                 data = json.load(f)
             self._rv = data["rv"]
             for rec in data["objects"]:
-                self._objects[tuple(rec["key"])] = rec["obj"]
+                self._put_obj(tuple(rec["key"]), rec["obj"])
         if os.path.exists(self._wal.path):
             with open(self._wal.path, encoding="utf-8") as f:
                 for line in f:
@@ -652,9 +1024,9 @@ class LogicalStore:
                     rec = json.loads(line)
                     key = tuple(rec["key"])
                     if rec["op"] == "put":
-                        self._objects[key] = rec["obj"]
+                        self._put_obj(key, rec["obj"])
                     elif rec["op"] == "del":
-                        self._objects.pop(key, None)
+                        self._del_obj(key)
                     self._rv = max(self._rv, rec.get("rv", 0))
 
     def snapshot(self) -> None:
@@ -687,6 +1059,7 @@ class LogicalStore:
         self._wal.mutations_since_snapshot = 0
 
     def close(self) -> None:
+        self._flush_events()
         for w in list(self._watches):
             w.close()
         if self._engine is not None:

@@ -92,6 +92,21 @@ def _sync_view(obj: dict) -> dict:
     return view
 
 
+def _sync_view_ro(obj: dict) -> dict:
+    """:func:`_sync_view` without the deepcopy tax, for read-only
+    consumers (the encoders hash it, `_spec_differs` compares it). The
+    nested values stay shared with the informer caches — which the CoW
+    store shares with storage — so callers must not mutate the result;
+    write paths keep using the deep-copying :func:`_sync_view` /
+    :func:`transform_for_downstream`."""
+    out = {k: v for k, v in obj.items() if k != "status"}
+    meta = out.get("metadata") or {}
+    out["metadata"] = {k: v for k, v in meta.items() if k not in _STRIP_META}
+    if "status" in obj:
+        out["status"] = obj["status"]
+    return out
+
+
 class BatchSyncEngine:
     """One batched sync program for one GVR between two clusters.
 
@@ -229,9 +244,9 @@ class BatchSyncEngine:
         up_obj = self.up_informer.get(self._up_cluster(), name, ns)
         down_obj = self.down_informer.get(self._down_cluster(), name, ns)
         s = self.enc.capacity
-        up_v = (self.enc.encode(_sync_view(up_obj)) if up_obj is not None
+        up_v = (self.enc.encode(_sync_view_ro(up_obj)) if up_obj is not None
                 else np.zeros(s, np.uint32))
-        down_v = (self.enc.encode(_sync_view(down_obj)) if down_obj is not None
+        down_v = (self.enc.encode(_sync_view_ro(down_obj)) if down_obj is not None
                   else np.zeros(s, np.uint32))
         # converged-by-observation: both sides present and identical means
         # this key's churn has landed — close its convergence sample here
@@ -384,11 +399,11 @@ class BatchSyncEngine:
             try:
                 for (_cl, ns, name), obj in self.up_informer.cache.items():
                     r = self._row_for((ns, name))
-                    self.enc.encode(_sync_view(obj), out=self.up_vals[r])
+                    self.enc.encode(_sync_view_ro(obj), out=self.up_vals[r])
                     self.up_exists[r] = True
                 for (_cl, ns, name), obj in self.down_informer.cache.items():
                     r = self._row_for((ns, name))
-                    self.enc.encode(_sync_view(obj), out=self.down_vals[r])
+                    self.enc.encode(_sync_view_ro(obj), out=self.down_vals[r])
                     self.down_exists[r] = True
                 break
             except BucketOverflow:
@@ -479,12 +494,12 @@ class BatchSyncEngine:
             down_obj = self.down_informer.get(self._down_cluster(), name, ns)
             idxs.append(r)
             up_rows.append(
-                self.enc.encode(_sync_view(up_obj)) if up_obj is not None
+                self.enc.encode(_sync_view_ro(up_obj)) if up_obj is not None
                 else np.zeros(self.enc.capacity, np.uint32)
             )
             up_ex.append(up_obj is not None)
             down_rows.append(
-                self.enc.encode(_sync_view(down_obj)) if down_obj is not None
+                self.enc.encode(_sync_view_ro(down_obj)) if down_obj is not None
                 else np.zeros(self.enc.capacity, np.uint32)
             )
             down_ex.append(down_obj is not None)
@@ -600,8 +615,11 @@ class BatchSyncEngine:
 
     @staticmethod
     def _spec_differs(desired: dict, current: dict) -> bool:
-        return _sync_view(desired) != {
-            k: v for k, v in _sync_view(current).items() if k != "status"
+        # pure comparison: the copy-free views suffice (and with informer
+        # caches sharing CoW store snapshots, skipping the deepcopy here
+        # keeps host verification off the per-patch allocation budget)
+        return _sync_view_ro(desired) != {
+            k: v for k, v in _sync_view_ro(current).items() if k != "status"
         }
 
     @staticmethod

@@ -38,6 +38,10 @@ _DEFAULT_BUCKETS = (
 # edges would fold every observation into one bucket
 DEPTH_BUCKETS = (0.0, 1.0, 2.0, 3.0, 4.0, 8.0)
 
+# count-shaped histograms (batch sizes, e.g. ``watch_fanout_batch_size``):
+# powers of two up to the store's emit-batch ceiling
+SIZE_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0, 512.0)
+
 
 @dataclass
 class Counter:

@@ -55,6 +55,23 @@ print("pipeline A/B smoke ok:",
       "| speedup:", r.get("pipeline_speedup"))
 '
 
+echo "== store: CPU microbench smoke (10k objects, 64 watches) with regression floor"
+store_line=$(KCP_BENCH_STORE_OBJECTS=10000 KCP_BENCH_STORE_MUTS=1500 \
+    python bench.py --store | tail -1)
+printf '%s\n' "$store_line" | python -c '
+import json, sys
+r = json.loads(sys.stdin.readline())
+v = r["value"]
+sb = r["store_bench"]
+assert sb["events_equal"], "indexed/legacy watch event counts diverged"
+# regression floor: the indexed read path measured ~9x combined at this
+# shape when it landed; 4x leaves slack for slow CI hosts while still
+# catching a lost index or a reintroduced per-event deepcopy
+assert v >= 4.0, "store read-path speedup regressed: %sx < 4x floor" % v
+print("store smoke ok: %sx combined | %sx list | %sx fan-out"
+      % (v, sb["list_speedup"], sb["fanout_speedup"]))
+'
+
 if [[ "$fast" == "0" ]]; then
     echo "== demo: both golden scenarios, checked against committed output"
     python contrib/demo/run_demo.py all --check

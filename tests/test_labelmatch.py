@@ -7,10 +7,14 @@ from kcp_tpu.ops.hashing import hash_pair
 from kcp_tpu.ops.labelmatch import (
     compile_selector,
     fanout_match_jit,
+    fanout_match_np,
     match_batch_jit,
+    match_batch_np,
     match_host,
+    try_compile_selector,
 )
 from kcp_tpu.store.selectors import parse_selector
+from kcp_tpu.utils.trace import REGISTRY
 
 SELECTORS = [
     "app=web",
@@ -73,3 +77,55 @@ def test_fanout_match():
             assert not row.any()
         else:
             assert row.sum() == 1 and row[clusters.index(c)]
+    # the numpy host twin is bit-identical to the device kernel
+    np.testing.assert_array_equal(fanout_match_np(pairs, sel_hashes), got)
+
+
+def test_match_batch_np_matches_device_and_host():
+    rng = np.random.default_rng(11)
+    label_maps = [random_labels(rng) for _ in range(128)]
+    pairs, keys = encode_label_batch(label_maps, capacity=8)
+    for spec in SELECTORS:
+        sel = parse_selector(spec)
+        c = compile_selector(sel)
+        got = match_batch_np(pairs, keys, c)
+        np.testing.assert_array_equal(got, match_host(sel, label_maps),
+                                      err_msg=f"selector {spec!r}")
+        dev = np.asarray(match_batch_jit(pairs, keys, c.alts, c.negate,
+                                         c.use_key, c.valid))
+        np.testing.assert_array_equal(got, dev, err_msg=f"selector {spec!r}")
+
+
+def test_try_compile_oversized_returns_none_and_counts():
+    before = REGISTRY.counter("labelmatch_fallback_total").value
+    nine_reqs = parse_selector(",".join(f"k{i}" for i in range(9)))
+    assert try_compile_selector(nine_reqs) is None
+    nine_alts = parse_selector("team in (a,b,c,d,e,f,g,h,i)")
+    assert try_compile_selector(nine_alts) is None
+    assert REGISTRY.counter("labelmatch_fallback_total").value == before + 2
+    # a kernel-shaped selector still compiles (and raising compile keeps
+    # its contract for device callers)
+    assert try_compile_selector(parse_selector("team=a")) is not None
+    import pytest
+
+    with pytest.raises(ValueError):
+        compile_selector(nine_reqs)
+
+
+def test_compile_selector_custom_hashers():
+    # interning hashers (the store's exact fan-out): sequential nonzero
+    # ids instead of 32-bit string hashes
+    pairs_tab, keys_tab = {}, {}
+
+    def pid(k, v):
+        return pairs_tab.setdefault((k, v), len(pairs_tab) + 1)
+
+    def kid(k):
+        return keys_tab.setdefault(k, len(keys_tab) + 1)
+
+    sel = parse_selector("app=web,env notin (prod),!legacy")
+    c = compile_selector(sel, pair_hash=pid, key_hash=kid)
+    assert c.alts[0, 0] == pairs_tab[("app", "web")]
+    assert c.alts[1, 0] == pairs_tab[("env", "prod")]
+    assert c.alts[2, 0] == keys_tab["legacy"]
+    assert c.negate[1] and c.negate[2] and c.use_key[2]
