@@ -101,6 +101,7 @@ _CORE = [
     ("", "v1", "configmaps", "ConfigMap", True),
     ("", "v1", "secrets", "Secret", True),
     ("", "v1", "serviceaccounts", "ServiceAccount", True),
+    ("", "v1", "resourcequotas", "ResourceQuota", True),
     ("", "v1", "services", "Service", True),
     ("", "v1", "pods", "Pod", True),
     ("apps", "v1", "deployments", "Deployment", True),

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import random
 from typing import Awaitable, Callable, Iterable
 
 from ..apis.scheme import GVR
@@ -80,6 +81,22 @@ class Informer:
         self._watch = None
         self._stopping = False
         self.rewatch_backoff = 0.2  # reflector retry pacing on stream loss
+        self.retry_after_cap = 30.0  # ceiling on server Retry-After hints
+
+    def _retry_delay(self, err: BaseException | None) -> float:
+        """Reflector retry pacing: the flat rewatch backoff, unless the
+        server sent a 429 with a Retry-After hint — then sleep the
+        hinted interval (jittered up to +25% so a fleet of informers
+        doesn't re-arrive in lockstep, capped so a bogus hint can't
+        park the cache for minutes)."""
+        hint = getattr(err, "retry_after", None)
+        if hint is None:
+            return self.rewatch_backoff
+        try:
+            base = min(float(hint), self.retry_after_cap)
+        except (TypeError, ValueError):
+            return self.rewatch_backoff
+        return max(self.rewatch_backoff, base * (1.0 + 0.25 * random.random()))
 
     # ------------------------------------------------------------ wiring
 
@@ -176,23 +193,29 @@ class Informer:
         controller would silently run against a frozen cache forever.
         """
         assert self._watch is not None
+        delay = self.rewatch_backoff
         while True:
             try:
                 async for ev in self._watch:
                     self._dispatch(ev)
-            except Exception:  # noqa: BLE001 — expired window / transport error
-                log.warning("informer %s: watch failed; re-listing", self.gvr,
-                            exc_info=True)
+                delay = self.rewatch_backoff
+            except Exception as err:  # noqa: BLE001 — expired window / transport error
+                delay = self._retry_delay(err)
+                log.warning("informer %s: watch failed; re-listing in %.2fs",
+                            self.gvr, delay, exc_info=True)
             if self._stopping:
                 return
-            await asyncio.sleep(self.rewatch_backoff)
+            await asyncio.sleep(delay)
             try:
                 rv = self._relist()
                 self._watch = self.client.watch(
                     self.gvr, self.namespace, self.selector, since_rv=rv)
-            except Exception:  # noqa: BLE001 — server still down; retry
-                log.warning("informer %s: re-list failed; retrying", self.gvr,
-                            exc_info=True)
+                delay = self.rewatch_backoff
+            except Exception as err:  # noqa: BLE001 — server down or shedding load
+                # an overloaded frontend's 429 hint paces the next lap
+                delay = self._retry_delay(err)
+                log.warning("informer %s: re-list failed; retrying in %.2fs",
+                            self.gvr, delay, exc_info=True)
 
     def _relist(self) -> int:
         """Fresh list reconciled against the cache (replace semantics)."""

@@ -41,6 +41,10 @@ Injection points wired in this codebase:
     syncer.apply                 syncer/engine.py applier pool
     device.step                  syncer/core.py FusedBucket.submit/probe
     cluster.health               reconcilers/cluster pull-mode healthcheck
+    admission.chain              admission/chain.py chain entry (writes)
+    admission.quota              admission/quota.py post-reservation
+                                 (an injected error exercises rollback)
+    admission.flow               admission/flow.py FlowController.acquire
 
 Sites call the module-level helpers, which are near-free no-ops when no
 injector is active (one global read).

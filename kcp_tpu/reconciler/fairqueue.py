@@ -24,7 +24,7 @@ import ctypes
 import time
 from typing import Callable, Hashable
 
-from .queue import WorkQueue
+from .queue import WorkQueue, queue_metrics
 
 Item = Hashable
 
@@ -56,6 +56,12 @@ class FairWorkQueue:
         self._tenants: dict[str, int] = {}
         self._wakeup = asyncio.Event()
         self._shutdown = False
+        # backpressure observables (see queue.queue_metrics): queue time
+        # is measured from immediate adds only — delayed/rate-limited
+        # requeues would fold their intentional backoff into the
+        # histogram and hide real queueing
+        self._depth_gauge, self._wait_hist = queue_metrics(name)
+        self._enq_t: dict[int, float] = {}
 
     @staticmethod
     def _declare(lib) -> None:
@@ -115,7 +121,10 @@ class FairWorkQueue:
     def add(self, item: Item) -> None:
         if self._shutdown:
             return
-        self._lib.wq_add(self._q, self._id(item), self._tenant(item))
+        i = self._id(item)
+        self._lib.wq_add(self._q, i, self._tenant(item))
+        self._enq_t.setdefault(i, time.monotonic())
+        self._depth_gauge.set(self._lib.wq_len(self._q))
         self._wakeup.set()
 
     def add_many(self, items) -> None:
@@ -129,10 +138,15 @@ class FairWorkQueue:
             return
         ids = (ctypes.c_uint64 * n)()
         tenants = (ctypes.c_uint32 * n)()
+        now = time.monotonic()  # one clock read for the whole batch
+        enq = self._enq_t
         for j, item in enumerate(items):
-            ids[j] = self._id(item)
+            i = self._id(item)
+            ids[j] = i
             tenants[j] = self._tenant(item)
+            enq.setdefault(i, now)
         self._lib.wq_add_many(self._q, ids, tenants, n)
+        self._depth_gauge.set(self._lib.wq_len(self._q))
         self._wakeup.set()
 
     def complete_many(self, items, forget_flags) -> None:
@@ -161,6 +175,7 @@ class FairWorkQueue:
             if released[j]:
                 del self._ids[item]
                 del self._items[i]
+                self._enq_t.pop(i, None)
         # done() may have requeued redo items natively — wake any getter
         self._wakeup.set()
 
@@ -194,12 +209,22 @@ class FairWorkQueue:
         if self._lib.wq_release(self._q, i):
             del self._ids[item]
             del self._items[i]
+            self._enq_t.pop(i, None)
 
     # ------------------------------------------------------------ consuming
 
     def _pop_ready(self, max_items: int) -> list[Item]:
         buf = (ctypes.c_uint64 * max_items)()
-        n = self._lib.wq_drain(self._q, time.monotonic(), buf, max_items)
+        now = time.monotonic()
+        n = self._lib.wq_drain(self._q, now, buf, max_items)
+        if n:
+            enq = self._enq_t
+            observe = self._wait_hist.observe
+            for i in range(n):
+                t = enq.pop(buf[i], None)
+                if t is not None:
+                    observe(now - t)
+            self._depth_gauge.set(self._lib.wq_len(self._q))
         return [self._items[buf[i]] for i in range(n)]
 
     async def get(self) -> Item | None:

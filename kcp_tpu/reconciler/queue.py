@@ -13,14 +13,33 @@ from __future__ import annotations
 
 import asyncio
 import heapq
+import re
 import time
 from collections import deque
 from typing import Hashable
+
+from ..utils.trace import REGISTRY
 
 Item = Hashable
 
 BASE_DELAY = 0.005  # client-go default rate limiter: 5ms * 2^n, capped
 MAX_DELAY = 1000.0
+
+
+def queue_metrics(name: str):
+    """(depth gauge, queue-seconds histogram) for a named workqueue —
+    the backpressure observables (client-go's workqueue_depth /
+    workqueue_queue_duration_seconds analogs): operators watch depth
+    climb and queue time stretch to see admission throttling propagate
+    into the controllers. Shared by WorkQueue and FairWorkQueue."""
+    suffix = re.sub(r"[^A-Za-z0-9_]", "_", name)
+    depth = REGISTRY.gauge(
+        f"workqueue_depth_{suffix}",
+        f"items ready or delayed in the {name} workqueue")
+    wait = REGISTRY.histogram(
+        "workqueue_queue_seconds",
+        "time items spent queued before a worker picked them up")
+    return depth, wait
 
 
 class WorkQueue:
@@ -35,6 +54,8 @@ class WorkQueue:
         self._retries: dict[Item, int] = {}
         self._wakeup: asyncio.Event = asyncio.Event()
         self._shutdown = False
+        self._depth_gauge, self._wait_hist = queue_metrics(name)
+        self._enq_t: dict[Item, float] = {}
 
     # ------------------------------------------------------------ adding
 
@@ -48,6 +69,8 @@ class WorkQueue:
             return
         self._pending.add(item)
         self._ready.append(item)
+        self._enq_t.setdefault(item, time.monotonic())
+        self._depth_gauge.set(len(self))
         self._wakeup.set()
 
     def add_after(self, item: Item, delay: float) -> None:
@@ -60,6 +83,7 @@ class WorkQueue:
             return
         self._seq += 1
         heapq.heappush(self._delayed, (time.monotonic() + delay, self._seq, item))
+        self._depth_gauge.set(len(self))
         self._wakeup.set()
 
     def add_rate_limited(self, item: Item) -> None:
@@ -101,9 +125,15 @@ class WorkQueue:
             elif item not in self._pending:
                 self._pending.add(item)
                 self._ready.append(item)
+                self._enq_t.setdefault(item, now)
         if self._delayed:
             return max(0.0, self._delayed[0][0] - now)
         return None
+
+    def _took(self, item: Item, now: float) -> None:
+        t = self._enq_t.pop(item, None)
+        if t is not None:
+            self._wait_hist.observe(now - t)
 
     async def get(self) -> Item | None:
         """Next item, or None on shutdown. Caller must call done(item)."""
@@ -113,6 +143,8 @@ class WorkQueue:
                 item = self._ready.popleft()
                 self._pending.discard(item)
                 self._processing.add(item)
+                self._took(item, time.monotonic())
+                self._depth_gauge.set(len(self))
                 return item
             if self._shutdown:
                 return None
@@ -142,6 +174,7 @@ class WorkQueue:
                 item = self._ready.popleft()
                 self._pending.discard(item)
                 self._processing.add(item)
+                self._took(item, time.monotonic())
                 batch.append(item)
                 continue
             remaining = deadline - time.monotonic()
@@ -152,6 +185,7 @@ class WorkQueue:
                 await asyncio.wait_for(self._wakeup.wait(), timeout=remaining)
             except asyncio.TimeoutError:
                 break
+        self._depth_gauge.set(len(self))
         return batch
 
     def done(self, item: Item) -> None:

@@ -22,6 +22,7 @@ import functools
 import json
 import os
 
+from ..admission.chain import NOOP_TICKET
 from ..apis.scheme import GVR, ResourceInfo, Scheme
 from ..store.selectors import parse_selector
 from ..store.store import WILDCARD, LogicalStore
@@ -45,7 +46,21 @@ def _status_body(code: int, reason: str, message: str) -> dict:
 
 
 def _error_response(err: errors.ApiError) -> Response:
-    return Response.of_json(_status_body(err.code, err.reason, err.message), err.code)
+    body = _status_body(err.code, err.reason, err.message)
+    headers: dict[str, str] = {}
+    retry_after = getattr(err, "retry_after", None)
+    if retry_after is not None:
+        # flow-control rejection (429): the pacing hint rides both the
+        # HTTP header (for generic clients) and the Status details (for
+        # RestClient, which only parses the body on watch streams)
+        import math
+
+        seconds = max(1, int(math.ceil(float(retry_after))))
+        body["details"] = {"retryAfterSeconds": seconds}
+        headers["Retry-After"] = str(seconds)
+    resp = Response.of_json(body, err.code)
+    resp.headers.update(headers)
+    return resp
 
 
 class RestHandler:
@@ -53,11 +68,21 @@ class RestHandler:
 
     def __init__(self, store: LogicalStore, scheme: Scheme,
                  version_info: dict | None = None,
-                 authenticator=None, authorizer=None):
+                 authenticator=None, authorizer=None,
+                 admission="auto"):
         self.store = store
         self.scheme = scheme
         self.authenticator = authenticator
         self.authorizer = authorizer  # None = authz off (open prototype mode)
+        # admission & flow control between authz and the store verbs
+        # (admission/): "auto" builds the default chain (defaulting →
+        # validation → quota, env-configured flow control) unless
+        # KCP_ADMISSION=0; None disables; an AdmissionChain is used as-is
+        if admission == "auto":
+            from ..admission import build_chain
+
+            admission = build_chain(store)
+        self.admission = admission or None
         self.version_info = version_info or {"major": "0", "minor": "1",
                                              "gitVersion": "kcp-tpu-v0.1.0"}
         # /readyz gate: flipped by Server once post-start hooks complete
@@ -392,7 +417,22 @@ class RestHandler:
         if req.method == "POST" and name is None:
             obj = self._body_object(req)
             target = resolve_write_cluster(cluster, obj, errors.BadRequestError)
-            created = await self._st(self.store.create, res, target, obj, namespace)
+            # admission inline (reads never touch it): admit_nowait only
+            # hands back a coroutine when flow control parks the request,
+            # so the uncontended write path stays synchronous
+            adm = self.admission
+            if adm is None:
+                ticket = NOOP_TICKET
+            else:
+                got = adm.admit_nowait("create", res, target, namespace, obj)
+                ticket = got if hasattr(got, "ok") else await got
+            try:
+                created = await self._st(
+                    self.store.create, res, target, obj, namespace)
+            except BaseException:
+                ticket.fail()
+                raise
+            ticket.ok()
             return Response.of_json(self._stamp(created, info, gv), 201)
 
         if req.method == "PUT" and name is not None:
@@ -402,19 +442,43 @@ class RestHandler:
                 raise errors.BadRequestError(
                     f"name in URL ({name}) does not match name in object ({body_name})")
             target = resolve_write_cluster(cluster, obj, errors.BadRequestError)
-            if subresource == "status":
-                updated = await self._st(
-                    self.store.update_status, res, target, obj, namespace)
+            adm = self.admission
+            if adm is None:
+                ticket = NOOP_TICKET
             else:
-                updated = await self._st(self.store.update, res, target, obj, namespace)
+                got = adm.admit_nowait("update", res, target, namespace, obj)
+                ticket = got if hasattr(got, "ok") else await got
+            try:
+                if subresource == "status":
+                    updated = await self._st(
+                        self.store.update_status, res, target, obj, namespace)
+                else:
+                    updated = await self._st(
+                        self.store.update, res, target, obj, namespace)
+            except BaseException:
+                ticket.fail()
+                raise
+            ticket.ok()
             return Response.of_json(self._stamp(updated, info, gv))
 
         if req.method == "DELETE" and name is not None:
             target = await self._read_cluster(cluster, res, name, namespace)
-            await self._st(self.store.delete, res, target, name, namespace)
+            adm = self.admission
+            if adm is None:
+                ticket = NOOP_TICKET
+            else:
+                got = adm.admit_nowait("delete", res, target, namespace, None)
+                ticket = got if hasattr(got, "ok") else await got
+            try:
+                await self._st(self.store.delete, res, target, name, namespace)
+            except BaseException:
+                ticket.fail()
+                raise
+            ticket.ok()
             return Response.of_json(_status_body(200, "Deleted", f"{res} {name} deleted"))
 
         raise errors.BadRequestError(f"unsupported method {req.method} for {req.path}")
+
 
     @staticmethod
     def _body_object(req: Request) -> dict:

@@ -13,6 +13,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import os
 from dataclasses import dataclass, field
 from typing import Awaitable, Callable
 from urllib.parse import parse_qs, unquote, urlsplit
@@ -20,7 +21,21 @@ from urllib.parse import parse_qs, unquote, urlsplit
 log = logging.getLogger(__name__)
 
 MAX_HEADER_BYTES = 64 * 1024
-MAX_BODY_BYTES = 16 * 1024 * 1024
+# request-body ceiling (KCP_MAX_BODY_BYTES): the cheapest admission
+# control of all — a declared body over the limit is refused 413 before
+# a single payload byte is buffered. 3 MiB default ~= the apiserver's
+# etcd request ceiling; read at import, overridable per-process.
+MAX_BODY_BYTES = int(os.environ.get("KCP_MAX_BODY_BYTES", str(3 * 1024 * 1024)))
+
+
+class RequestTooLarge(Exception):
+    """Raised by request parsing when Content-Length exceeds
+    MAX_BODY_BYTES; the connection loop answers 413 and closes (the
+    unread body makes the connection unusable for keep-alive)."""
+
+    def __init__(self, size: int):
+        super().__init__(f"request body {size} bytes exceeds limit")
+        self.size = size
 
 
 @dataclass
@@ -105,9 +120,11 @@ class StreamResponse:
                 pass
 
 
-_REASONS = {200: "OK", 201: "Created", 400: "Bad Request", 404: "Not Found",
-            405: "Method Not Allowed", 409: "Conflict", 410: "Gone",
-            422: "Unprocessable Entity", 500: "Internal Server Error"}
+_REASONS = {200: "OK", 201: "Created", 400: "Bad Request", 403: "Forbidden",
+            404: "Not Found", 405: "Method Not Allowed", 409: "Conflict",
+            410: "Gone", 413: "Request Entity Too Large",
+            422: "Unprocessable Entity", 429: "Too Many Requests",
+            500: "Internal Server Error", 503: "Service Unavailable"}
 
 
 def _reason(status: int) -> str:
@@ -159,7 +176,27 @@ class HttpServer:
             task.add_done_callback(self._conns.discard)
         try:
             while True:
-                req = await self._read_request(reader)
+                try:
+                    req = await self._read_request(reader)
+                except RequestTooLarge as e:
+                    # 413 instead of buffering: the body was never read,
+                    # so answer and close rather than resynchronize
+                    body = json.dumps({
+                        "kind": "Status", "apiVersion": "v1",
+                        "status": "Failure",
+                        "reason": "RequestEntityTooLarge",
+                        "message": (f"request body of {e.size} bytes exceeds "
+                                    f"the {MAX_BODY_BYTES}-byte limit "
+                                    f"(KCP_MAX_BODY_BYTES)"),
+                        "code": 413,
+                    }).encode()
+                    writer.write(
+                        f"HTTP/1.1 413 {_reason(413)}\r\n"
+                        "Content-Type: application/json\r\n"
+                        f"Content-Length: {len(body)}\r\n"
+                        "Connection: close\r\n\r\n".encode() + body)
+                    await writer.drain()
+                    break
                 if req is None:
                     break
                 try:
@@ -248,7 +285,7 @@ class HttpServer:
         clen = int(headers.get("content-length", "0") or "0")
         if clen:
             if clen > MAX_BODY_BYTES:
-                return None
+                raise RequestTooLarge(clen)
             body = await reader.readexactly(clen)
         parts = urlsplit(target)
         return Request(

@@ -30,32 +30,48 @@ from ..utils.circuit import CircuitBreaker
 from ..utils.routing import resolve_write_cluster
 
 
-def _status_error(code: int, reason: str, message: str) -> errors.ApiError:
+def _status_error(code: int, reason: str, message: str,
+                  details: dict | None = None,
+                  retry_after: float | None = None) -> errors.ApiError:
     """Map a Status (code, reason) to the ApiError taxonomy — shared by
-    response handling and in-stream watch ERROR events."""
+    response handling and in-stream watch ERROR events. 429s become the
+    typed TooManyRequestsError carrying the server's Retry-After pacing
+    hint (header or Status ``details.retryAfterSeconds``)."""
     by_reason = {
         "NotFound": errors.NotFoundError,
         "AlreadyExists": errors.AlreadyExistsError,
         "Conflict": errors.ConflictError,
         "Invalid": errors.InvalidError,
         "BadRequest": errors.BadRequestError,
+        "Forbidden": errors.ForbiddenError,
+        "TooManyRequests": errors.TooManyRequestsError,
+        "ServiceUnavailable": errors.UnavailableError,
     }
     cls = by_reason.get(reason)
     if cls is None:
         cls = {404: errors.NotFoundError, 409: errors.ConflictError,
-               422: errors.InvalidError, 400: errors.BadRequestError}.get(
-                   code, errors.ApiError)
+               422: errors.InvalidError, 400: errors.BadRequestError,
+               403: errors.ForbiddenError,
+               429: errors.TooManyRequestsError,
+               503: errors.UnavailableError}.get(code, errors.ApiError)
     err = cls(message)
     if cls is errors.ApiError and code >= 400:
-        # codes without a dedicated class (401/403/...) keep their real
+        # codes without a dedicated class (401/...) keep their real
         # code + reason on the instance so relays don't flatten to 500
         err.code = code
         if reason:
             err.reason = reason
+    if isinstance(err, errors.TooManyRequestsError):
+        hint = (details or {}).get("retryAfterSeconds", retry_after)
+        try:
+            err.retry_after = max(0.0, float(hint))
+        except (TypeError, ValueError):
+            pass  # class default (1.0) stands
     return err
 
 
-def _raise_for_status(code: int, body: bytes) -> None:
+def _raise_for_status(code: int, body: bytes,
+                      retry_after: float | None = None) -> None:
     if code < 400:
         return
     try:
@@ -63,7 +79,9 @@ def _raise_for_status(code: int, body: bytes) -> None:
     except (ValueError, UnicodeDecodeError):
         status = {}
     message = status.get("message", body.decode("latin-1")[:200])
-    raise _status_error(code, status.get("reason", ""), message)
+    raise _status_error(code, status.get("reason", ""), message,
+                        details=status.get("details"),
+                        retry_after=retry_after)
 
 
 class RestWatch:
@@ -162,9 +180,11 @@ class RestWatch:
                 self.error = errors.ConflictError(message)
             else:
                 # a relayed backend refusal (403 bad store token, 404,
-                # ...): carry the real taxonomy so callers don't relist
-                # forever against a watch that can never be served
-                self.error = _status_error(code, reason, message)
+                # 429 throttling, ...): carry the real taxonomy so
+                # callers don't relist forever against a watch that can
+                # never be served — and so 429s keep their pacing hint
+                self.error = _status_error(code, reason, message,
+                                           details=obj.get("details"))
             self._closed = True
             self._events.put_nowait(None)
             return
@@ -364,7 +384,15 @@ class RestClient:
                 self._breaker.record_failure()
                 raise
             self._breaker.record_success()
-            _raise_for_status(resp.status, data)
+            retry_after = None
+            if resp.status == 429:
+                # a throttling answer is the peer ALIVE (the breaker saw
+                # record_success above); surface the pacing hint instead
+                try:
+                    retry_after = float(resp.getheader("Retry-After") or "")
+                except ValueError:
+                    pass
+            _raise_for_status(resp.status, data, retry_after=retry_after)
             return json.loads(data) if data else None
         return None  # unreachable
 

@@ -252,6 +252,16 @@ class Server:
             # (server.go:325-356)
             NamespaceLifecycleController(self.client),
         ]
+        admission = getattr(self.handler, "admission", None)
+        if admission is not None and admission.ledger is not None:
+            # quota usage-recount reconciler (admission/quota.py):
+            # applies ResourceQuota limit changes (including in-process
+            # writes that bypass the REST chain) and periodically repairs
+            # ledger drift against the store's true counts
+            from ..admission import UsageRecountController
+
+            self._controllers.append(UsageRecountController(
+                self.client, admission.ledger, self.store))
         for c in self._controllers:
             await c.start()
 

@@ -341,6 +341,11 @@ class LogicalStore:
         from ..utils.raceguard import AffinityGuard
 
         self._race_guard = AffinityGuard("LogicalStore")
+        # admission quota accounting: called (resource, cluster, +1/-1)
+        # whenever the object map gains/loses a key — the mutation-level
+        # usage hook the QuotaLedger attaches (admission/quota.py). None
+        # (the default) is one attribute read per mutation.
+        self._usage_hook = None
         self._objects: dict[Key, dict] = {}
         self._rv = 0
         self._watches: list[Watch] = []
@@ -440,11 +445,15 @@ class LogicalStore:
 
     def _put_obj(self, key: Key, obj: dict) -> None:
         """Insert/replace an object in the map and the secondary index."""
+        if self._usage_hook is not None and key not in self._objects:
+            self._usage_hook(key[0], key[1], 1)
         self._objects[key] = obj
         r, c, n, _ = key
         self._buckets.setdefault(r, {}).setdefault(c, {}).setdefault(n, {})[key] = obj
 
     def _del_obj(self, key: Key) -> None:
+        if self._usage_hook is not None and key in self._objects:
+            self._usage_hook(key[0], key[1], -1)
         self._objects.pop(key, None)
         r, c, n, _ = key
         res = self._buckets.get(r)
@@ -693,6 +702,21 @@ class LogicalStore:
                          "objects examined by store list scans").inc(scanned)
         REGISTRY.counter("store_list_returned_total",
                          "objects returned by store lists").inc(returned)
+
+    def set_usage_hook(self, hook) -> None:
+        """Install the per-mutation usage callback
+        ``hook(resource, cluster, delta)`` (admission quota ledger)."""
+        self._usage_hook = hook
+
+    def counts(self) -> dict[tuple[str, str], int]:
+        """Object counts per (resource, cluster) from the secondary
+        index — the naive full recount the quota ledger reconciles
+        against (bucket lengths only, no object walk)."""
+        return {
+            (r, c): sum(len(ns) for ns in cl.values())
+            for r, res in self._buckets.items()
+            for c, cl in res.items()
+        }
 
     def resources(self) -> list[str]:
         """Distinct resource names present in the store."""

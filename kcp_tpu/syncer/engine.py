@@ -340,6 +340,14 @@ class BatchSyncEngine:
                         self.cluster_id, self.gvr, key, n - 1, err)
             return
         delay = min(0.005 * (2 ** min(n, 10)), 5.0)
+        hint = errors.retry_after_hint(err)
+        if hint is not None:
+            # 429 from an overloaded frontend: honor the server's pacing
+            # hint (jittered so the applier pool doesn't re-arrive in
+            # lockstep, capped so a bogus hint can't stall the row)
+            import random
+
+            delay = max(delay, min(hint, 30.0) * (1.0 + 0.25 * random.random()))
         log.info("sync-%s-%s: apply %r failed (attempt %d): %s",
                  self.cluster_id, self.gvr, key, n, err)
         t = asyncio.get_event_loop().create_task(

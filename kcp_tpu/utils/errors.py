@@ -47,6 +47,26 @@ class BadRequestError(ApiError):
     reason = "BadRequest"
 
 
+class ForbiddenError(ApiError):
+    """Policy denial (quota exceeded, RBAC): the request is understood
+    and well-formed but refused — retrying unchanged cannot succeed."""
+
+    code = 403
+    reason = "Forbidden"
+
+
+class TooManyRequestsError(ApiError):
+    """Flow-control rejection (429): the server is shedding load for this
+    flow. ``retry_after`` carries the server's pacing hint in seconds —
+    clients sleep (jittered, capped) instead of hammering an overloaded
+    frontend; the REST surface mirrors it as a ``Retry-After`` header and
+    a ``details.retryAfterSeconds`` Status field."""
+
+    code = 429
+    reason = "TooManyRequests"
+    retry_after = 1.0
+
+
 class UnavailableError(ApiError):
     """A dependency is (temporarily) unreachable or refusing service:
     injected 5xx faults, circuit-broken remote I/O, dead store backends.
@@ -87,3 +107,17 @@ def is_conflict(err: BaseException) -> bool:
 
 def is_already_exists(err: BaseException) -> bool:
     return isinstance(err, AlreadyExistsError)
+
+
+def is_too_many_requests(err: BaseException) -> bool:
+    return isinstance(err, TooManyRequestsError)
+
+
+def retry_after_hint(err: BaseException) -> float | None:
+    """The server's Retry-After pacing hint in seconds, if the error
+    carries one (429 flow-control rejections do)."""
+    ra = getattr(err, "retry_after", None)
+    try:
+        return float(ra) if ra is not None else None
+    except (TypeError, ValueError):
+        return None

@@ -72,6 +72,28 @@ print("store smoke ok: %sx combined | %sx list | %sx fan-out"
       % (v, sb["list_speedup"], sb["fanout_speedup"]))
 '
 
+echo "== admission: happy-path overhead + noisy-neighbor storm smoke"
+# 1 tenant floods writes at 10x its token rate alongside quiet tenants:
+# quiet p99 must stay within 2x of its no-storm baseline with ZERO quiet
+# rejections, the flood must see 429 + Retry-After, and the chain's
+# happy-path overhead on the serving path must stay under 5%
+adm_line=$(KCP_BENCH_ADM_WRITES=3000 KCP_BENCH_ADM_TENANTS=40 \
+    KCP_BENCH_ADM_STORM_S=2 python bench.py --admission | tail -1)
+printf '%s\n' "$adm_line" | python -c '
+import json, sys
+r = json.loads(sys.stdin.readline())
+st = r["admission_bench"]["storm"]
+assert r["value"] < 5.0, "happy-path admission overhead %s%% >= 5%%" % r["value"]
+assert st["quiet_rejected"] == 0, st
+assert st["quiet_p99_ratio"] <= 2.0, st
+assert st["flood_429"] > 0 and st["flood_retry_after_seen"], st
+assert st["flood_ok"] < st["flood_sent"] // 2, "flood was not throttled: %s" % st
+print("admission smoke ok: overhead %.2f%% (direct %.2f%%) | quiet p99 ratio"
+      " %.2f | flood throttled %d/%d with Retry-After"
+      % (r["value"], r["admission_bench"]["happy"]["direct_overhead_pct"],
+         st["quiet_p99_ratio"], st["flood_429"], st["flood_sent"]))
+'
+
 if [[ "$fast" == "0" ]]; then
     echo "== demo: both golden scenarios, checked against committed output"
     python contrib/demo/run_demo.py all --check
