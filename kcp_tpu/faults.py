@@ -45,6 +45,9 @@ Injection points wired in this codebase:
     admission.quota              admission/quota.py post-reservation
                                  (an injected error exercises rollback)
     admission.flow               admission/flow.py FlowController.acquire
+    encode.cache                 store/store.py encode-once byte cache
+                                 (``drop`` discards a cached entry on
+                                 lookup, forcing the re-encode fallback)
 
 Sites call the module-level helpers, which are near-free no-ops when no
 injector is active (one global read).

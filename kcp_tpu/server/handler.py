@@ -21,6 +21,7 @@ import asyncio
 import functools
 import json
 import os
+import time
 
 from ..admission.chain import NOOP_TICKET
 from ..apis.scheme import GVR, ResourceInfo, Scheme
@@ -28,6 +29,7 @@ from ..store.selectors import parse_selector
 from ..store.store import WILDCARD, LogicalStore
 from ..utils import errors
 from ..utils.routing import resolve_write_cluster
+from ..utils.trace import REGISTRY
 from .httpd import Request, Response, StreamResponse
 
 DEFAULT_CLUSTER = "admin"
@@ -102,6 +104,29 @@ class RestHandler:
 
             self._store_pool = ThreadPoolExecutor(
                 max_workers=8, thread_name_prefix="store-io")
+        # encode-once serving (KCP_ENCODE_CACHE, in-process CoW stores
+        # only): list responses splice cached item bytes, single GETs
+        # splice the cached body, and the watch relay threads pre-encoded
+        # event lines — remote-store frontends re-serialize what the
+        # backend sent, so they keep the dict path.
+        self._encode = (not self._remote
+                        and bool(getattr(store, "encode_cache_enabled", False))
+                        and callable(getattr(store, "encode_many", None))
+                        and callable(getattr(store, "encode_events", None)))
+        self._spans = callable(getattr(store, "list_encoded", None))
+        self._enc_seconds = REGISTRY.histogram(
+            "response_encode_seconds",
+            "time serializing one list/get/watch-batch response body")
+        # RV-keyed list-body cache: the store RV increments on every
+        # mutation, so (query shape, rv) fully determines a list
+        # response's bytes — informer relists and polling dashboards
+        # repeat identical list queries against an unchanged store, and
+        # those hits skip even the byte-splice. Small FIFO (bodies can
+        # be tens of MB at 100k objects); bypassed while a KCP_FAULTS
+        # schedule is active so encode.cache drops always reach the
+        # per-record cache underneath.
+        self._list_cache: dict[tuple, tuple[int, bytes]] = {}
+        self._list_cache_max = 8
 
     async def _st(self, fn, *args, **kwargs):
         """Run a store call; offloaded to the I/O pool for remote stores."""
@@ -396,16 +421,26 @@ class RestHandler:
                 if req.param("watch") in ("true", "1"):
                     return self._watch(req, cluster, res, namespace or None)
                 selector = parse_selector(req.param("labelSelector"))
+                if self._encode and not as_table:
+                    return await self._list_encoded(
+                        req, cluster, res, namespace, selector, info, gv)
                 items, rv = await self._st(
                     self.store.list, res, cluster, namespace or None, selector)
                 if as_table:  # kubectl get: server-side printer columns
                     return Response.of_json(render_table(res, items, rv))
-                return Response.of_json({
+                t0 = time.perf_counter()
+                resp = Response.of_json({
                     "kind": info.list_kind, "apiVersion": gv,
                     "metadata": {"resourceVersion": str(rv)},
                     "items": items,
                 })
+                self._enc_seconds.observe(time.perf_counter() - t0)
+                return resp
             target = await self._read_cluster(cluster, res, name, namespace)
+            if self._encode and not as_table:
+                raw = self._get_encoded(res, target, name, namespace)
+                if raw is not None:
+                    return Response(body=raw)
             obj = await self._st(self.store.get, res, target, name, namespace)
             # no table transform for the status subresource (matches the
             # real apiserver: table rendering applies to objects, not
@@ -494,6 +529,75 @@ class RestHandler:
         obj.setdefault("kind", info.kind)
         obj.setdefault("apiVersion", gv)
         return obj
+
+    async def _list_encoded(self, req: Request, cluster: str, res: str,
+                            namespace: str, selector, info: ResourceInfo,
+                            gv: str) -> Response:
+        """Encode-once list serving: (1) an RV-keyed body cache answers
+        repeated identical queries against an unchanged store without
+        touching the items at all; (2) unselected lists assemble from
+        the store's per-bucket span caches (no global sort, no per-item
+        probe); (3) selector lists byte-splice the per-snapshot cached
+        bytes. All three are byte-identical to dumping the full dict."""
+        from .. import faults as _faults
+
+        cacheable = _faults._ACTIVE is None and _faults._ENV_CHECKED
+        ck = (res, cluster, namespace, req.param("labelSelector") or "", gv)
+        if cacheable:
+            ent = self._list_cache.get(ck)
+            if ent is not None and ent[0] == self.store.resource_version:
+                REGISTRY.counter("encode_cache_hits_total").inc()
+                REGISTRY.counter(
+                    "encode_cache_bytes_shared_total").inc(len(ent[1]))
+                return Response(body=ent[1])
+        t0 = time.perf_counter()
+        if selector.empty and self._spans:
+            spans, rv = await self._st(
+                self.store.list_encoded, res, cluster, namespace or None)
+        else:
+            items, rv = await self._st(
+                self.store.list, res, cluster, namespace or None, selector)
+            spans = self.store.encode_many(items)
+        # byte-splice: the envelope is dumped once with an empty items
+        # array, then the item/span bytes are joined in place of the
+        # final `]}` — byte-identical to dumping the full dict, without
+        # re-serializing 100k objects per request. ONE join builds the
+        # body: at 100k objects the body is tens of MB, so every extra
+        # concatenation is a full-copy tax
+        head = json.dumps({
+            "kind": info.list_kind, "apiVersion": gv,
+            "metadata": {"resourceVersion": str(rv)},
+            "items": [],
+        }).encode()
+        parts = [head[:-2]]
+        for i, span in enumerate(spans):
+            if i:
+                parts.append(b", ")
+            parts.append(span)
+        parts.append(b"]}")
+        body = b"".join(parts)
+        self._enc_seconds.observe(time.perf_counter() - t0)
+        if cacheable:
+            if (len(self._list_cache) >= self._list_cache_max
+                    and ck not in self._list_cache):
+                self._list_cache.pop(next(iter(self._list_cache)))
+            self._list_cache[ck] = (rv, body)
+        return Response(body=body)
+
+    def _get_encoded(self, res: str, cluster: str, name: str,
+                     namespace: str) -> bytes | None:
+        """Cached body for a single-object GET (encode-once: no deepcopy,
+        no dumps on a warm snapshot). None when :meth:`_stamp` would have
+        to add kind/apiVersion defaults — that rare shape takes the dict
+        path so the wire stays byte-identical either way. In-process
+        stores only (``self._encode``), so this runs inline."""
+        snap = self.store.get_snapshot(res, cluster, name, namespace)
+        if "kind" not in snap or "apiVersion" not in snap:
+            return None
+        t0 = time.perf_counter()
+        raw = self.store.encode_obj(snap)
+        self._enc_seconds.observe(time.perf_counter() - t0)
+        return raw
 
     async def _read_cluster(self, cluster: str, res: str, name: str,
                             namespace: str) -> str:
@@ -641,8 +745,18 @@ class RestHandler:
                     # is unaffected. Streams without the batch method
                     # (test fakes/duck types) get the per-event sends.
                     batch = [ev, *watch.drain()]
+                    send_raw = (getattr(stream, "send_raw_many", None)
+                                if self._encode else None)
                     send_many = getattr(stream, "send_json_many", None)
-                    if send_many is not None:
+                    if send_raw is not None:
+                        # encode-once: every relay serving this store
+                        # splices the same cached event-line bytes — a
+                        # 64-watcher fan-out encodes each event once
+                        t0 = loop.time()
+                        lines = self.store.encode_events(batch)
+                        self._enc_seconds.observe(loop.time() - t0)
+                        await send_raw(lines)
+                    elif send_many is not None:
                         await send_many(
                             [{"type": e.type, "object": e.object} for e in batch])
                     else:

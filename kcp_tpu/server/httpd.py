@@ -111,6 +111,19 @@ class StreamResponse:
         self._writer.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
         await self._writer.drain()
 
+    async def send_raw_many(self, lines) -> None:
+        """Pre-encoded newline-terminated JSON lines in ONE chunk + one
+        drain — the encode-once twin of :meth:`send_json_many`. The relay
+        hands every watcher the same cached bytes (store.encode_event),
+        so a 64-way fan-out costs one encode instead of 64; the chunked
+        framing is byte-identical to the json path."""
+        assert self._writer is not None
+        if not lines:
+            return
+        data = b"".join(lines)
+        self._writer.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+        await self._writer.drain()
+
     async def _finish(self) -> None:
         if self._writer is not None:
             try:

@@ -15,6 +15,7 @@ unchanged over the wire.
 from __future__ import annotations
 
 import asyncio
+import codecs
 import http.client
 import json
 import threading
@@ -105,6 +106,12 @@ class RestWatch:
         self._closed = False
         self.error: Exception | None = None  # set on non-2xx watch responses
         self.last_rv = 0  # highest RV seen (events + bookmarks), for resume
+        # chunk reassembly state (_feed): decoded-but-incomplete trailing
+        # line, and an incremental UTF-8 decoder so each chunk is decoded
+        # exactly once — a multi-byte sequence straddling a chunk
+        # boundary is carried by the decoder, not re-scanned
+        self._buf = ""
+        self._decoder = codecs.getincrementaldecoder("utf-8")()
 
     def _ensure_started(self) -> None:
         if self._task is None and not self._closed:
@@ -134,7 +141,6 @@ class RestWatch:
                 except errors.ApiError as e:
                     self.error = e
                 return
-            buf = b""
             while True:
                 if should_drop("watch"):
                     # injected stream loss (KCP_FAULTS `watch:drop...`):
@@ -149,15 +155,7 @@ class RestWatch:
                     break
                 chunk = await reader.readexactly(size)
                 await reader.readexactly(2)  # trailing \r\n
-                # one split per chunk: the server's relay batches event
-                # bursts into multi-line chunks (send_json_many), and the
-                # old split-one-line-at-a-time loop rescanned the buffer
-                # per line
-                lines = (buf + chunk).split(b"\n")
-                buf = lines.pop()  # partial trailing line (usually empty)
-                for line in lines:
-                    if line.strip():
-                        self._handle_line(json.loads(line))
+                self._feed(chunk)
         except (ConnectionError, asyncio.IncompleteReadError, OSError,
                 ValueError, IndexError):
             pass  # connection died or stream garbled → clean end-of-stream
@@ -166,6 +164,22 @@ class RestWatch:
                 writer.close()
             self._closed = True
             self._events.put_nowait(None)
+
+    def _feed(self, chunk: bytes) -> None:
+        """Reassemble one chunk payload into complete event lines.
+
+        The chunk is decoded to ``str`` exactly once and split in one
+        pass; ``json.loads`` then parses ready text instead of
+        re-detecting and re-decoding bytes per line (the server's relay
+        batches event bursts into multi-line chunks, so a chunk commonly
+        carries many events). The incomplete trailing line — and any
+        multi-byte UTF-8 sequence the chunk boundary split — carries
+        over to the next chunk."""
+        lines = (self._buf + self._decoder.decode(chunk)).split("\n")
+        self._buf = lines.pop()  # partial trailing line (usually empty)
+        for line in lines:
+            if line.strip():
+                self._handle_line(json.loads(line))
 
     def _handle_line(self, msg: dict) -> None:
         if msg.get("type") == "ERROR":

@@ -48,6 +48,23 @@ Read path (KCP_STORE_INDEX=1, the default):
 ``KCP_STORE_INDEX=0`` (or ``indexed=False``) keeps the pre-index scan +
 per-event deepcopy path for A/B measurement (``bench.py --store``).
 
+Encode-once serving (KCP_ENCODE_CACHE=1, the default, indexed stores):
+
+- the CoW contract above makes serialized bytes a *pure function of the
+  snapshot object*: a per-record byte cache (:meth:`encode_obj`) is
+  populated lazily on first encode and needs no invalidation protocol —
+  a mutation replaces the snapshot, so the identity-keyed entry simply
+  stops matching (replaced/deleted snapshots are evicted for memory
+  only, not correctness);
+- watch events carry their encoded ``{"type", "object"}`` wire line on
+  the :class:`Event` itself (:meth:`encode_event`), so a burst fanned
+  out to 64 relays is encoded once, not 64 times — rewritten
+  (label-transition) events are shared across matched watches for the
+  same reason;
+- ``KCP_ENCODE_CACHE=0`` keeps the per-call ``json.dumps`` serving path
+  for A/B (``bench.py --encode``), and the ``encode.cache`` KCP_FAULTS
+  point force-drops cached entries to exercise the re-encode fallback.
+
 Thread-model: single-threaded synchronous core intended to be called from
 one asyncio event loop; watches buffer into deques and optionally notify an
 asyncio.Event so async consumers can await new events.
@@ -82,6 +99,10 @@ WILDCARD = "*"
 
 def _env_indexed() -> bool:
     return os.environ.get("KCP_STORE_INDEX", "1").lower() not in ("0", "false", "off")
+
+
+def _env_encode_cache() -> bool:
+    return os.environ.get("KCP_ENCODE_CACHE", "1").lower() not in ("0", "false", "off")
 
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
@@ -312,10 +333,17 @@ class LogicalStore:
         wal_sync_every: int = 256,
         namespace_lifecycle: bool = False,
         indexed: bool | None = None,
+        encode_cache: bool | None = None,
     ):
         """``indexed``: None reads ``KCP_STORE_INDEX`` (default on) —
         False keeps the pre-index linear-scan/deepcopy read path and the
         per-watch python fan-out for A/B measurement.
+
+        ``encode_cache``: None reads ``KCP_ENCODE_CACHE`` (default on) —
+        False keeps per-call ``json.dumps`` serving for A/B
+        (``bench.py --encode``). Only effective on indexed stores: the
+        cache's validity rests on the CoW snapshot contract, which the
+        legacy deepcopy-per-read path does not provide.
 
         ``wal_backend``: "auto" uses the native C++ engine
         (native/walstore.cc — binary records, CRC32 torn-write recovery,
@@ -369,6 +397,31 @@ class LogicalStore:
         self._intern_pairs: dict = {}
         self._intern_keys: dict[str, int] = {}
         self._labelmatch = None  # lazy ops.labelmatch module (pulls jax)
+        # encode-once byte cache: id(snapshot) -> (snapshot, bytes). The
+        # entry holds a strong ref to its snapshot, so a live id can
+        # never be reused by a different object — presence implies
+        # identity. Mutation replaces the snapshot (CoW), which is the
+        # whole invalidation story; _put_obj/_del_obj evict replaced
+        # snapshots purely to bound memory to the live object set.
+        self._encode_cache = (_env_encode_cache() if encode_cache is None
+                              else bool(encode_cache)) and self._indexed
+        self._enc_bytes: dict[int, tuple[dict, bytes]] = {}
+        # per-bucket list spans: (resource, cluster, namespace) ->
+        # (bucket version, b", ".join of the bucket's sorted item
+        # bytes). A mutation bumps the bucket's version, so an
+        # unselected list re-joins only the buckets that changed and
+        # concatenates the rest — no global sort, no per-item probe.
+        self._span_cache: dict[tuple[str, str, str], tuple[int, bytes]] = {}
+        self._bucket_ver: dict[tuple[str, str, str], int] = {}
+        self._enc_hits = REGISTRY.counter(
+            "encode_cache_hits_total",
+            "serializations served from the encode-once byte cache")
+        self._enc_misses = REGISTRY.counter(
+            "encode_cache_misses_total",
+            "serializations that had to run json.dumps")
+        self._enc_shared = REGISTRY.counter(
+            "encode_cache_bytes_shared_total",
+            "response bytes served from cached encodings")
         self._wal: _WalConfig | None = None
         self._engine = None
         self._engine_mutations = 0
@@ -445,15 +498,29 @@ class LogicalStore:
 
     def _put_obj(self, key: Key, obj: dict) -> None:
         """Insert/replace an object in the map and the secondary index."""
-        if self._usage_hook is not None and key not in self._objects:
+        old = self._objects.get(key)
+        if self._usage_hook is not None and old is None:
             self._usage_hook(key[0], key[1], 1)
+        if self._encode_cache:
+            if old is not None and self._enc_bytes:
+                # memory hygiene only: the replaced snapshot's cached
+                # bytes can never be served again (identity mismatch)
+                self._enc_bytes.pop(id(old), None)
+            bk = key[:3]
+            self._bucket_ver[bk] = self._bucket_ver.get(bk, 0) + 1
         self._objects[key] = obj
         r, c, n, _ = key
         self._buckets.setdefault(r, {}).setdefault(c, {}).setdefault(n, {})[key] = obj
 
     def _del_obj(self, key: Key) -> None:
-        if self._usage_hook is not None and key in self._objects:
-            self._usage_hook(key[0], key[1], -1)
+        old = self._objects.get(key)
+        if old is not None:
+            if self._usage_hook is not None:
+                self._usage_hook(key[0], key[1], -1)
+            if self._encode_cache:
+                self._enc_bytes.pop(id(old), None)
+                bk = key[:3]
+                self._bucket_ver[bk] = self._bucket_ver.get(bk, 0) + 1
         self._objects.pop(key, None)
         r, c, n, _ = key
         res = self._buckets.get(r)
@@ -467,6 +534,7 @@ class LogicalStore:
             return
         ns.pop(key, None)
         if not ns:
+            self._span_cache.pop(key[:3], None)
             del cl[n]
             if not cl:
                 del res[c]
@@ -529,6 +597,19 @@ class LogicalStore:
         if obj is None:
             raise NotFoundError(f"{resource} {cluster}/{namespace}/{name} not found")
         return copy.deepcopy(obj)
+
+    def get_snapshot(self, resource: str, cluster: str, name: str,
+                     namespace: str = "") -> dict:
+        """The stored snapshot itself, no copy — the CoW read for encode
+        paths (callers must not mutate the result; mutators start from
+        :meth:`get`). Fault-injected exactly like :meth:`get` so cached
+        and uncached serving fail identically under KCP_FAULTS."""
+        _inject("store.get")
+        key = self._key(resource, cluster, namespace, name)
+        obj = self._objects.get(key)
+        if obj is None:
+            raise NotFoundError(f"{resource} {cluster}/{namespace}/{name} not found")
+        return obj
 
     def update(
         self,
@@ -729,6 +810,190 @@ class LogicalStore:
     def __len__(self) -> int:
         return len(self._objects)
 
+    # ------------------------------------------------ encode-once serving
+
+    @property
+    def encode_cache_enabled(self) -> bool:
+        """True when serving paths may splice cached snapshot bytes
+        (KCP_ENCODE_CACHE on an indexed/CoW store)."""
+        return self._encode_cache
+
+    def encode_obj(self, obj: dict) -> bytes:
+        """Default-format JSON bytes of a stored snapshot, computed once
+        per snapshot object.
+
+        The bytes are valid for exactly as long as the snapshot object is
+        reachable: CoW means a mutation replaces the snapshot, so a stale
+        entry can never be looked up again (its id only matches while the
+        entry's own strong reference keeps the old object alive). The
+        ``encode.cache`` fault point force-drops a cached entry to
+        exercise the re-encode fallback.
+        """
+        if not self._encode_cache:
+            return json.dumps(obj).encode()
+        ent = self._enc_bytes.get(id(obj))
+        if ent is not None and ent[0] is obj:
+            if should_drop("encode.cache"):
+                del self._enc_bytes[id(obj)]
+            else:
+                self._enc_hits.inc()
+                self._enc_shared.inc(len(ent[1]))
+                return ent[1]
+        data = json.dumps(obj).encode()
+        self._enc_misses.inc()
+        self._enc_bytes[id(obj)] = (obj, data)
+        return data
+
+    def encode_many(self, objs: list[dict]) -> list[bytes]:
+        """:meth:`encode_obj` over a list result, with the per-item
+        bookkeeping hoisted out of the loop (one counter update per call,
+        fault checks only while an injector is active) — the list
+        response splice path runs this over 100k items per request."""
+        if not self._encode_cache:
+            return [json.dumps(o).encode() for o in objs]
+        from .. import faults as _faults
+
+        if _faults._ACTIVE is not None or not _faults._ENV_CHECKED:
+            # an active KCP_FAULTS schedule must see one encode.cache
+            # decision per entry, exactly like the per-item path
+            return [self.encode_obj(o) for o in objs]
+        cache = self._enc_bytes
+        dumps = json.dumps
+        out: list[bytes] = []
+        hits = misses = shared = 0
+        for o in objs:
+            ent = cache.get(id(o))
+            if ent is not None and ent[0] is o:
+                data = ent[1]
+                hits += 1
+                shared += len(data)
+            else:
+                data = dumps(o).encode()
+                cache[id(o)] = (o, data)
+                misses += 1
+            out.append(data)
+        if hits:
+            self._enc_hits.inc(hits)
+            self._enc_shared.inc(shared)
+        if misses:
+            self._enc_misses.inc(misses)
+        return out
+
+    def list_encoded(
+        self,
+        resource: str,
+        cluster: str = WILDCARD,
+        namespace: str | None = None,
+    ) -> tuple[list[bytes], int]:
+        """Encode-once fast path for *unselected* lists: ``(spans, rv)``
+        where each span is one candidate bucket's sorted item bytes
+        pre-joined with ``b", "`` — from the per-bucket span caches, so
+        an unchanged bucket costs one list append instead of a sort +
+        per-item probe (the caller splices spans straight into the
+        response envelope with a single join). Scope semantics, result
+        ordering, fault injection and list metrics are identical to
+        :meth:`list` with an empty selector (bucket keys iterate in
+        sorted order, which *is* the global ``(clusterName, namespace,
+        name)`` sort — resource is constant and names sort within their
+        bucket)."""
+        _inject("store.list")
+        scanned = 0
+        spans: list[bytes] = []
+        res_b = self._buckets.get(resource)
+        if res_b:
+            if cluster != WILDCARD:
+                cl_keys = [cluster] if cluster in res_b else []
+            else:
+                cl_keys = sorted(res_b)
+            for c in cl_keys:
+                cl_b = res_b[c]
+                if namespace is not None:
+                    ns_keys = [namespace] if namespace in cl_b else []
+                else:
+                    ns_keys = sorted(cl_b)
+                for n in ns_keys:
+                    ns_b = cl_b[n]
+                    scanned += len(ns_b)
+                    spans.append(self._bucket_span((resource, c, n), ns_b))
+        self._list_metrics(scanned, scanned)  # empty selector: all returned
+        return spans, self._rv
+
+    def _bucket_span(self, bk: tuple[str, str, str], ns_b: dict) -> bytes:
+        from .. import faults as _faults
+
+        ver = self._bucket_ver.get(bk, 0)
+        if _faults._ACTIVE is None and _faults._ENV_CHECKED:
+            ent = self._span_cache.get(bk)
+            if ent is not None and ent[0] == ver:
+                self._enc_hits.inc()
+                self._enc_shared.inc(len(ent[1]))
+                return ent[1]
+            span = b", ".join(self.encode_many(
+                [obj for _, obj in sorted(ns_b.items())]))
+            self._span_cache[bk] = (ver, span)
+            return span
+        # active fault schedule: every entry decision must reach the
+        # per-record cache (encode.cache drops), so spans are neither
+        # read nor stored
+        return b", ".join(self.encode_many(
+            [obj for _, obj in sorted(ns_b.items())]))
+
+    def encode_event(self, ev: Event) -> bytes:
+        """The encoded watch wire line ``{"type": ..., "object": ...}\\n``
+        for an event, computed once and cached on the event itself — the
+        store's batched fan-out pushes the *same* Event instance to every
+        matched watch, so 64 relays splice one encoding. Byte-identical
+        to ``json.dumps({"type": ev.type, "object": ev.object})``."""
+        if self._encode_cache:
+            line = ev.__dict__.get("_enc_line")
+            if line is not None:
+                if should_drop("encode.cache"):
+                    object.__setattr__(ev, "_enc_line", None)
+                else:
+                    self._enc_hits.inc()
+                    self._enc_shared.inc(len(line))
+                    return line
+        # DELETED events (and events outlived by later writes) carry a
+        # snapshot that is no longer the stored one — encode it without
+        # touching the per-record cache, or dead snapshots would pin
+        # entries forever. The line cache above still shares the work.
+        if self._encode_cache and self._objects.get(ev.key) is ev.object:
+            body = self.encode_obj(ev.object)
+        else:
+            body = json.dumps(ev.object).encode()
+            if self._encode_cache:
+                self._enc_misses.inc()
+        line = (b'{"type": ' + json.dumps(ev.type).encode()
+                + b', "object": ' + body + b'}\n')
+        if self._encode_cache:
+            object.__setattr__(ev, "_enc_line", line)
+        return line
+
+    def encode_events(self, evs: list[Event]) -> list[bytes]:
+        """:meth:`encode_event` over a relay batch with the per-line
+        bookkeeping hoisted out of the loop (the 64-watcher fan-out runs
+        this once per watcher per burst — the hit path must cost a dict
+        probe, not a metrics transaction)."""
+        from .. import faults as _faults
+
+        if (not self._encode_cache or _faults._ACTIVE is not None
+                or not _faults._ENV_CHECKED):
+            return [self.encode_event(ev) for ev in evs]
+        out: list[bytes] = []
+        hits = shared = 0
+        for ev in evs:
+            line = ev.__dict__.get("_enc_line")
+            if line is None:
+                line = self.encode_event(ev)  # miss path counts itself
+            else:
+                hits += 1
+                shared += len(line)
+            out.append(line)
+        if hits:
+            self._enc_hits.inc(hits)
+            self._enc_shared.inc(shared)
+        return out
+
     # -------------------------------------------------------------- watch
 
     def watch(
@@ -906,7 +1171,12 @@ class LogicalStore:
                              | (is_mod[:, None] & nm & om))
             to_add = scope & is_mod[:, None] & nm & ~om
             to_del = scope & is_mod[:, None] & ~nm & om
-            # argwhere is row-major: per-watch delivery stays in rv order
+            # argwhere is row-major: per-watch delivery stays in rv order.
+            # Rewritten (label-transition) events are built once per
+            # source event and shared across every matched watch, so the
+            # encode-once wire cache on the Event pays off for them too.
+            rw_add: dict[int, Event] = {}
+            rw_del: dict[int, Event] = {}
             for ni, ci in np.argwhere(as_is | to_add | to_del):
                 w = mx_ws[ci]
                 if w._closed:
@@ -915,11 +1185,19 @@ class LogicalStore:
                 if as_is[ni, ci]:
                     w._push(ev)
                 elif to_add[ni, ci]:
-                    w._push(Event(ADDED, ev.resource, ev.cluster, ev.namespace,
-                                  ev.name, ev.object, ev.rv, ev.old_object))
+                    out = rw_add.get(ni)
+                    if out is None:
+                        out = rw_add[ni] = Event(
+                            ADDED, ev.resource, ev.cluster, ev.namespace,
+                            ev.name, ev.object, ev.rv, ev.old_object)
+                    w._push(out)
                 else:
-                    w._push(Event(DELETED, ev.resource, ev.cluster, ev.namespace,
-                                  ev.name, ev.object, ev.rv, ev.old_object))
+                    out = rw_del.get(ni)
+                    if out is None:
+                        out = rw_del[ni] = Event(
+                            DELETED, ev.resource, ev.cluster, ev.namespace,
+                            ev.name, ev.object, ev.rv, ev.old_object)
+                    w._push(out)
         for w in fb_ws:
             # oversized selector: exact per-event fallback
             for ev in evs:

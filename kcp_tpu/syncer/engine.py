@@ -40,6 +40,7 @@ from __future__ import annotations
 import asyncio
 import copy
 import logging
+import os
 import time
 from typing import Sequence
 
@@ -163,6 +164,19 @@ class BatchSyncEngine:
         )
 
         self.enc = BucketEncoder(capacity=64)
+        # encode-once memo for the _sync_view_ro encode path: the CoW
+        # store (and the informer caches fed from it) never mutates a
+        # snapshot in place, so the uint32 row for a snapshot is a pure
+        # function of the dict — keyed by id with a strong ref (presence
+        # implies identity), cleared whenever self.enc is replaced
+        # (slot assignments are append-only below that, so cached rows
+        # stay valid as the vocabulary grows). Periodic resyncs and
+        # level-triggered re-touches of unchanged keys hit this instead
+        # of re-flattening the object.
+        self._enc_memo_on = os.environ.get(
+            "KCP_ENCODE_CACHE", "1").lower() not in ("0", "false", "off")
+        self._enc_memo: dict[int, tuple[dict, np.ndarray]] = {}
+        self._enc_memo_max = 65536
         self.rows: dict[tuple[str, str], int] = {}  # (ns, name) -> row
         self.row_keys: list[tuple[str, str]] = []
         self.capacity = 0
@@ -236,6 +250,23 @@ class BatchSyncEngine:
     def fused_status_mask(self) -> np.ndarray:
         return self.enc.status_mask()
 
+    def _encode_view(self, obj: dict) -> np.ndarray:
+        """Encode-once ``enc.encode(_sync_view_ro(obj))``: memoized per
+        snapshot identity. The returned row is shared — callers copy it
+        into staging buffers, never mutate it."""
+        if not self._enc_memo_on:
+            return self.enc.encode(_sync_view_ro(obj))
+        ent = self._enc_memo.get(id(obj))
+        if ent is not None and ent[0] is obj:
+            return ent[1]
+        vec = self.enc.encode(_sync_view_ro(obj))
+        if len(self._enc_memo) >= self._enc_memo_max:
+            # blunt but bounded: informer caches churn snapshots, so a
+            # periodic full reset beats per-entry tracking on this path
+            self._enc_memo.clear()
+        self._enc_memo[id(obj)] = (obj, vec)
+        return vec
+
     def fused_encode(self, key: tuple[str, str]):
         """Re-encode one touched key from the informer caches for the
         shared bucket's scatter. Raises BucketOverflow if the vocabulary
@@ -244,9 +275,9 @@ class BatchSyncEngine:
         up_obj = self.up_informer.get(self._up_cluster(), name, ns)
         down_obj = self.down_informer.get(self._down_cluster(), name, ns)
         s = self.enc.capacity
-        up_v = (self.enc.encode(_sync_view_ro(up_obj)) if up_obj is not None
+        up_v = (self._encode_view(up_obj) if up_obj is not None
                 else np.zeros(s, np.uint32))
-        down_v = (self.enc.encode(_sync_view_ro(down_obj)) if down_obj is not None
+        down_v = (self._encode_view(down_obj) if down_obj is not None
                   else np.zeros(s, np.uint32))
         # converged-by-observation: both sides present and identical means
         # this key's churn has landed — close its convergence sample here
@@ -271,6 +302,7 @@ class BatchSyncEngine:
         prefix, so existing slot assignments stay valid), move to the
         larger bucket, and replay every cached key."""
         self.enc = self.enc.grown()
+        self._enc_memo.clear()  # rows are sized to the replaced encoder
         log.info("sync-%s-%s: bucket overflow, re-registering at %d slots",
                  self.cluster_id, self.gvr, self.enc.capacity)
         old = self._section
@@ -396,6 +428,7 @@ class BatchSyncEngine:
         re-encode both caches (the host escape hatch for odd objects)."""
         while True:
             self.enc = self.enc.grown()
+            self._enc_memo.clear()  # rows are sized to the replaced encoder
             log.info("%s: bucket overflow, re-encoding at %d slots",
                      self.controller.name, self.enc.capacity)
             cap = self.capacity
@@ -502,12 +535,12 @@ class BatchSyncEngine:
             down_obj = self.down_informer.get(self._down_cluster(), name, ns)
             idxs.append(r)
             up_rows.append(
-                self.enc.encode(_sync_view_ro(up_obj)) if up_obj is not None
+                self._encode_view(up_obj) if up_obj is not None
                 else np.zeros(self.enc.capacity, np.uint32)
             )
             up_ex.append(up_obj is not None)
             down_rows.append(
-                self.enc.encode(_sync_view_ro(down_obj)) if down_obj is not None
+                self._encode_view(down_obj) if down_obj is not None
                 else np.zeros(self.enc.capacity, np.uint32)
             )
             down_ex.append(down_obj is not None)

@@ -72,6 +72,23 @@ print("store smoke ok: %sx combined | %sx list | %sx fan-out"
       % (v, sb["list_speedup"], sb["fanout_speedup"]))
 '
 
+echo "== encode: encode-once serving A/B smoke (10k objects, 64 watchers) with regression floor"
+enc_line=$(KCP_BENCH_ENCODE_OBJECTS=10000 KCP_BENCH_ENCODE_MUTS=300 \
+    python bench.py --encode | tail -1)
+printf '%s\n' "$enc_line" | python -c '
+import json, sys
+r = json.loads(sys.stdin.readline())
+eb = r["encode_bench"]
+assert eb["bytes_equal"], "cached and uncached serving bytes diverged"
+assert eb["events_equal"], "cached/uncached watch event counts diverged"
+# regression floor: the encode-once path measured ~7x combined at this
+# shape when it landed; 3x leaves slack for slow CI hosts while still
+# catching a lost cache or a reintroduced per-watcher re-encode
+assert r["value"] >= 3.0, "encode-once speedup regressed: %sx < 3x floor" % r["value"]
+print("encode smoke ok: %sx combined | %sx churned-list | %sx fan-out-encode"
+      % (r["value"], eb["churn_list_speedup"], eb["fanout_encode_speedup"]))
+'
+
 echo "== admission: happy-path overhead + noisy-neighbor storm smoke"
 # 1 tenant floods writes at 10x its token rate alongside quiet tenants:
 # quiet p99 must stay within 2x of its no-storm baseline with ZERO quiet
