@@ -1219,6 +1219,435 @@ def encode_bench() -> int:
     return 0
 
 
+def _spawn_kcp(extra_args: list[str], timeout: float = 60.0):
+    """Spawn a real ``kcp start`` subprocess (plaintext, no controllers,
+    no syncer) and block until it announces its serving address. Returns
+    ``(Popen, address)``. The child never imports jax (no JAX_PLATFORMS,
+    compile cache off), so spawn cost is interpreter + server imports."""
+    import subprocess
+
+    cmd = [sys.executable, "-m", "kcp_tpu.cli.kcp", "start",
+           "--no-install-controllers", "--no-tls",
+           "--syncer-mode", "none"] + extra_args
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("KCP_FAULTS", None)  # a CI chaos schedule must not leak in
+    env["KCP_NO_COMPILE_CACHE"] = "1"
+    p = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                         stderr=subprocess.DEVNULL, env=env, text=True)
+    deadline = time.time() + timeout
+    while True:
+        line = p.stdout.readline()
+        if not line:
+            raise RuntimeError(
+                f"kcp start exited rc={p.poll()} before serving: {cmd}")
+        if line.startswith("kcp-tpu serving at "):
+            return p, line.rsplit(None, 1)[-1]
+        if time.time() > deadline:
+            p.kill()
+            raise RuntimeError(f"kcp start did not serve in {timeout}s")
+
+
+def shard_loadgen() -> int:
+    """Write-loadgen child for ``--sharded`` (``bench.py --shard-loadgen``,
+    parameters via ``KCP_LG_*``): ring-routes configmap creates straight
+    to each cluster's owning shard (the smart-client mode the rendezvous
+    ring is deterministic FOR — a production fleet scales routers
+    horizontally; the loadgen measures the shards, not one router
+    process). Prints ``ready`` after warmup, starts on a ``go`` line from
+    stdin (the cross-loadgen barrier), writes for KCP_LG_SECONDS, and
+    reports ``{"writes": N, "seconds": measured}`` as JSON."""
+    from kcp_tpu.server.rest import MultiClusterRestClient
+    from kcp_tpu.sharding import ShardRing
+
+    ring = ShardRing.from_spec(os.environ["KCP_LG_SPEC"])
+    clusters = os.environ["KCP_LG_CLUSTERS"].split(",")
+    seconds = float(os.environ["KCP_LG_SECONDS"])
+    prefix = os.environ["KCP_LG_PREFIX"]
+    # one wildcard client (= one kept-alive connection) per shard; writes
+    # carry metadata.clusterName, which the shard's own wildcard-write
+    # rule resolves — the same body works against a monolith unchanged
+    clients = [MultiClusterRestClient(s.url) for s in ring]
+    owner = {c: ring.owner_index(c) for c in clusters}
+
+    def body(k: int, warm: bool = False) -> dict:
+        c = clusters[k % len(clusters)]
+        name = f"{prefix}-{'w' if warm else 'n'}{k}"
+        return {"apiVersion": "v1", "kind": "ConfigMap",
+                "metadata": {"name": name, "namespace": "default",
+                             "clusterName": c},
+                "data": {}}, owner[c]
+
+    for k in range(2 * len(clients)):  # warm connections + discovery
+        obj, idx = body(k, warm=True)
+        clients[idx].create("configmaps", obj)
+    print("ready", flush=True)
+    sys.stdin.readline()  # the barrier: every loadgen starts together
+    n = 0
+    t0 = time.perf_counter()
+    stop = t0 + seconds
+    while time.perf_counter() < stop:
+        obj, idx = body(n)
+        clients[idx].create("configmaps", obj)
+        n += 1
+    print(json.dumps({"writes": n,
+                      "seconds": time.perf_counter() - t0}), flush=True)
+    return 0
+
+
+def sharded_bench() -> int:
+    """Sharded control plane A/B (``--sharded``): fleet write capacity at
+    1/2/4 shards, merged wildcard list/watch behavior through the router,
+    and the shard-kill drill. One JSON line; ``value`` is the fleet
+    *capacity* speedup at the largest fleet vs the 1-shard monolith.
+
+    Two scaling numbers, because they answer different questions:
+
+    - ``capacity_speedup`` (the headline): shards share nothing — no
+      cross-shard traffic on single-cluster writes, ring-partitioned
+      keyspace — so fleet capacity on N hosts is the sum of per-shard
+      rates. Each shard's rate is measured in its own time slice under
+      exactly its ring partition of the clusters (idle peers cost
+      nothing), which stays honest on CI hosts with fewer cores than
+      server processes. The gate is real: a ring that routed everything
+      to one shard, or any cross-shard chatter on the write path, drags
+      the sum back toward 1x.
+    - ``concurrent_speedup``: all shards driven simultaneously on THIS
+      host — the wall-clock truth, bounded by host cores (~1x on a
+      1-core CI runner; near the capacity number when cores >= fleet).
+
+    The router phases measure what the frontend adds: single-cluster
+    relay throughput through one router process, merged wildcard list
+    latency, write->merged-watch-event latency, and the kill drill
+    (victim SIGKILLed mid-traffic: fail-fast 503 once the breaker trips,
+    terminal in-stream 410 on the merged watch, zero acked writes lost
+    after the WAL-restored restart + relist catchup).
+    """
+    import signal
+    import subprocess
+    import tempfile
+    from urllib.parse import urlsplit
+
+    from kcp_tpu.server.rest import MultiClusterRestClient, RestClient
+    from kcp_tpu.sharding import ShardRing
+    from kcp_tpu.utils import errors as kerrors
+
+    fleets = sorted(int(x) for x in os.environ.get(
+        "KCP_BENCH_SHARD_FLEETS", "1,2,4").split(",") if x)
+    seconds = float(os.environ.get("KCP_BENCH_SHARD_SECONDS", "2.0"))
+    n_loadgens = int(os.environ.get("KCP_BENCH_SHARD_CLIENTS", "2"))
+    n_clusters = int(os.environ.get("KCP_BENCH_SHARD_CLUSTERS", "24"))
+    lat_events = int(os.environ.get("KCP_BENCH_SHARD_EVENTS", "40"))
+    clusters = [f"t{i}" for i in range(n_clusters)]
+
+    def start_loadgens(spec: str, subset: list[str], secs: float,
+                       tag: str) -> float:
+        """n_loadgens barrier-synced loadgen children over ``subset`` of
+        the clusters; returns the aggregate write rate."""
+        procs = []
+        for j in range(n_loadgens):
+            env = dict(os.environ,
+                       KCP_LG_SPEC=spec, KCP_LG_SECONDS=str(secs),
+                       KCP_LG_CLUSTERS=",".join(
+                           subset[j::n_loadgens] or subset),
+                       KCP_LG_PREFIX=f"{tag}-lg{j}")
+            env.pop("JAX_PLATFORMS", None)
+            env.pop("KCP_FAULTS", None)
+            env["KCP_NO_COMPILE_CACHE"] = "1"
+            procs.append(subprocess.Popen(
+                [sys.executable, sys.argv[0], "--shard-loadgen"],
+                stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                stderr=subprocess.DEVNULL, env=env, text=True))
+        for p in procs:
+            assert p.stdout.readline().strip() == "ready"
+        for p in procs:  # release the barrier everywhere at once
+            p.stdin.write("go\n")
+            p.stdin.flush()
+        rate = 0.0
+        for p in procs:
+            r = json.loads(p.stdout.readline())
+            rate += r["writes"] / r["seconds"]
+            p.stdin.close()
+            p.wait(timeout=30)
+        return rate
+
+    def stop_all(procs) -> None:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+    # ---- phase 1: write capacity at each fleet size
+    fleet_stats: dict[str, dict] = {}
+    largest: tuple[list, str, ShardRing] | None = None
+    for n in fleets:
+        procs, urls = [], []
+        try:
+            for _ in range(n):
+                p, addr = _spawn_kcp(["--in-memory", "--listen-port", "0"])
+                procs.append(p)
+                urls.append(addr)
+            spec = ",".join(f"s{i}={u}" for i, u in enumerate(urls))
+            ring = ShardRing.from_spec(spec)
+            owned = [[c for c in clusters if ring.owner_index(c) == i]
+                     for i in range(n)]
+            concurrent = start_loadgens(spec, clusters, seconds, f"f{n}c")
+            per_shard = []
+            for i in range(n):
+                # time-sliced capacity: only shard i's partition driven
+                rate = start_loadgens(spec, owned[i], max(1.0, seconds / n),
+                                      f"f{n}s{i}")
+                per_shard.append({"shard": i, "clusters": len(owned[i]),
+                                  "per_s": round(rate)})
+            fleet_stats[str(n)] = {
+                "concurrent_per_s": round(concurrent),
+                "capacity_per_s": round(sum(s["per_s"] for s in per_shard)),
+                "per_shard": per_shard,
+            }
+            if n == fleets[-1]:
+                largest = (procs, spec, ring)
+                procs = []  # keep the largest fleet alive for the router
+        finally:
+            stop_all(procs)
+
+    base = fleet_stats[str(fleets[0])]
+    capacity_speedup = {
+        str(n): round(fleet_stats[str(n)]["capacity_per_s"]
+                      / max(base["capacity_per_s"], 1), 2)
+        for n in fleets[1:]}
+    concurrent_speedup = {
+        str(n): round(fleet_stats[str(n)]["concurrent_per_s"]
+                      / max(base["concurrent_per_s"], 1), 2)
+        for n in fleets[1:]}
+
+    # ---- phase 2: the router over the largest fleet
+    assert largest is not None
+    shard_procs, spec, ring = largest
+    router_stats: dict = {}
+    try:
+        rp, raddr = _spawn_kcp(["--role", "router", "--shards", spec,
+                                "--in-memory", "--listen-port", "0"])
+        shard_procs.append(rp)
+        wc = MultiClusterRestClient(raddr)
+
+        # relay throughput: single-cluster writes through ONE router hop
+        c0 = clusters[0]
+        rc = RestClient(raddr, cluster=c0)
+        rc.create("configmaps", {"apiVersion": "v1", "kind": "ConfigMap",
+                                 "metadata": {"name": "relay-warm",
+                                              "namespace": "default"}})
+        t0 = time.perf_counter()
+        relay_n = 0
+        while time.perf_counter() - t0 < max(1.0, seconds / 2):
+            rc.create("configmaps", {
+                "apiVersion": "v1", "kind": "ConfigMap", "metadata": {
+                    "name": f"relay-{relay_n}", "namespace": "default"}})
+            relay_n += 1
+        relay_per_s = relay_n / (time.perf_counter() - t0)
+
+        # merged wildcard list latency (the fleet holds phase-1 objects)
+        lists = []
+        for _ in range(10):
+            t0 = time.perf_counter()
+            items, rv = wc.list("configmaps")
+            lists.append(time.perf_counter() - t0)
+
+        # write -> merged-watch-event latency across all shards
+        async def watch_lat() -> list[float]:
+            _items, rv = wc.list("configmaps")
+            w = wc.watch("configmaps", since_rv=rv)
+            await w.next_batch(0.05)
+            await asyncio.sleep(0.2)
+            lats = []
+            try:
+                for k in range(lat_events):
+                    c = clusters[k % len(clusters)]
+                    name = f"lat-{k}"
+                    t0 = time.perf_counter()
+                    wc.create("configmaps", {
+                        "apiVersion": "v1", "kind": "ConfigMap",
+                        "metadata": {"name": name, "namespace": "default",
+                                     "clusterName": c}})
+                    seen = False
+                    for _ in range(400):
+                        for ev in await w.next_batch(0.05):
+                            if ev.name == name:
+                                lats.append(time.perf_counter() - t0)
+                                seen = True
+                        if seen:
+                            break
+                    assert seen, f"merged watch never delivered {name}"
+            finally:
+                w.close()
+            return lats
+
+        lats = asyncio.run(watch_lat())
+        router_stats = {
+            "shards": len(ring),
+            "relay_per_s": round(relay_per_s),
+            "list_p50_ms": round(
+                float(np.percentile(np.asarray(lists), 50)) * 1e3, 2),
+            "watch_events": len(lats),
+            "watch_lat_p50_ms": round(
+                float(np.percentile(np.asarray(lats), 50)) * 1e3, 2),
+            "watch_lat_p99_ms": round(
+                float(np.percentile(np.asarray(lats), 99)) * 1e3, 2),
+        }
+    finally:
+        stop_all(shard_procs)
+
+    # ---- phase 3: shard-kill drill (2 durable shards + router)
+    kill_stats: dict = {}
+    with tempfile.TemporaryDirectory(prefix="kcp-sharded-") as tmp:
+        procs = []
+        try:
+            urls = []
+            for i in range(2):
+                p, addr = _spawn_kcp(["--root-dir",
+                                      os.path.join(tmp, f"shard{i}"),
+                                      "--listen-port", "0"])
+                procs.append(p)
+                urls.append(addr)
+            spec = ",".join(f"s{i}={u}" for i, u in enumerate(urls))
+            ring = ShardRing.from_spec(spec)
+            rp, raddr = _spawn_kcp(["--role", "router", "--shards", spec,
+                                    "--in-memory", "--listen-port", "0"])
+            procs.append(rp)
+            wc = MultiClusterRestClient(raddr)
+            # two clusters on distinct shards: a victim and a survivor
+            owners: dict[int, str] = {}
+            for i in range(64):
+                owners.setdefault(ring.owner_index(f"k{i}"), f"k{i}")
+                if len(owners) == 2:
+                    break
+            victim_idx, victim_c = sorted(owners.items())[0]
+            _surv_idx, surv_c = sorted(owners.items())[1]
+            acked: set[tuple[str, str]] = set()
+
+            def write(c: str, name: str, retry: bool = False) -> None:
+                while True:
+                    try:
+                        wc.create("configmaps", {
+                            "apiVersion": "v1", "kind": "ConfigMap",
+                            "metadata": {"name": name,
+                                         "namespace": "default",
+                                         "clusterName": c}})
+                        acked.add((c, name))
+                        return
+                    except kerrors.AlreadyExistsError:
+                        acked.add((c, name))
+                        return
+                    except (kerrors.UnavailableError, ConnectionError,
+                            OSError):
+                        if not retry:
+                            raise
+                        time.sleep(0.05)
+
+            for k in range(20):
+                write(victim_c, f"pre-{k}")
+                write(surv_c, f"pre-{k}")
+
+            async def drill() -> None:
+                _items, rv = wc.list("configmaps")
+                w = wc.watch("configmaps", since_rv=rv)
+                await w.next_batch(0.05)
+                await asyncio.sleep(0.2)
+                t_kill = time.perf_counter()
+                procs[victim_idx].kill()
+                procs[victim_idx].wait(timeout=10)
+                # the merged watch must end with a terminal in-stream 410
+                gone_ms = None
+                try:
+                    for _ in range(600):
+                        await w.next_batch(0.05)
+                except kerrors.GoneError:
+                    gone_ms = (time.perf_counter() - t_kill) * 1e3
+                finally:
+                    w.close()
+                kill_stats["watch_terminal_410"] = gone_ms is not None
+                kill_stats["watch_410_ms"] = round(gone_ms or -1.0, 1)
+                # victim-owned requests fail; once the breaker trips they
+                # fail FAST (503 without a connect attempt)
+                vc = RestClient(raddr, cluster=victim_c)
+                first_503_ms = None
+                attempt_ms = []
+                for k in range(8):
+                    t0 = time.perf_counter()
+                    try:
+                        vc.get("configmaps", "pre-0", "default")
+                    except (kerrors.UnavailableError, ConnectionError,
+                            OSError):
+                        pass
+                    dt = (time.perf_counter() - t0) * 1e3
+                    attempt_ms.append(dt)
+                    if first_503_ms is None:
+                        first_503_ms = round(
+                            (time.perf_counter() - t_kill) * 1e3, 1)
+                kill_stats["unavailable_after_kill_ms"] = first_503_ms
+                kill_stats["failfast_ms"] = round(min(attempt_ms[-3:]), 2)
+                # survivor keeps serving through the router all along
+                for k in range(10):
+                    write(surv_c, f"out-{k}")
+                # revive the victim on its OLD address, WAL-restored
+                port = urlsplit(urls[victim_idx]).port
+                deadline = time.time() + 30
+                while True:
+                    try:
+                        p2, _ = _spawn_kcp(
+                            ["--root-dir",
+                             os.path.join(tmp, f"shard{victim_idx}"),
+                             "--listen-port", str(port)])
+                        procs[victim_idx] = p2
+                        break
+                    except RuntimeError:
+                        if time.time() > deadline:
+                            raise
+                        time.sleep(0.3)
+                # catchup writes land once the breaker's probe re-closes
+                for k in range(10):
+                    write(victim_c, f"back-{k}", retry=True)
+
+            asyncio.run(drill())
+            # relist catchup: every acked write is present — zero lost
+            deadline = time.time() + 30
+            while True:
+                items, _rv = wc.list("configmaps")
+                have = {(o["metadata"]["clusterName"], o["metadata"]["name"])
+                        for o in items}
+                missing = acked - have
+                if not missing or time.time() > deadline:
+                    break
+                time.sleep(0.3)
+            kill_stats["acked_writes"] = len(acked)
+            kill_stats["lost_after_catchup"] = len(missing)
+        finally:
+            stop_all(procs)
+
+    top = str(fleets[-1])
+    out = {
+        "metric": "sharded_write_capacity_speedup",
+        "value": capacity_speedup.get(top, 1.0),
+        "unit": "x",
+        "sharded_bench": {
+            "host_cpus": os.cpu_count(),
+            "clusters": n_clusters,
+            "loadgens": n_loadgens,
+            "seconds": seconds,
+            "fleets": fleet_stats,
+            "capacity_speedup": capacity_speedup,
+            "concurrent_speedup": concurrent_speedup,
+            "router": router_stats,
+            "kill": kill_stats,
+        },
+    }
+    emit(out)
+    return 0
+
+
 # ---------------------------------------------------------------------------
 # Orchestrator: the TPU rides a tunnel that wedges transiently, and a hung
 # in-process backend init cannot be interrupted from within. So the default
@@ -1398,7 +1827,12 @@ def orchestrate(child_args: list[str]) -> int:
 
 if __name__ == "__main__":
     args = [a for a in sys.argv[1:] if a != "--child"]
-    if "--store" in args or "--admission" in args or "--encode" in args:
+    if "--shard-loadgen" in args:
+        # internal: the --sharded bench's write-driver child (never
+        # touches jax; shards are separate kcp processes)
+        sys.exit(shard_loadgen())
+    if ("--store" in args or "--admission" in args or "--encode" in args
+            or "--sharded" in args):
         # pure-host microbenches: pin CPU (never touch the tunnel)
         # and run in-process — no watchdog child needed
         try:
@@ -1409,6 +1843,7 @@ if __name__ == "__main__":
             pass
         sys.exit(store_bench() if "--store" in args
                  else admission_bench() if "--admission" in args
+                 else sharded_bench() if "--sharded" in args
                  else encode_bench())
     if "--probe" in args:
         # manual diagnostic: always run in-process (never through the

@@ -58,6 +58,18 @@ def build_parser():
                             "(reference: --auto_publish_apis)")
     start.add_argument("--resources-to-sync", default="deployments.apps",
                        help="comma-separated resources synced to physical clusters")
+    start.add_argument("--role", choices=["shard", "router"], default="shard",
+                       help="shard: a normal control-plane server (the "
+                            "default; shards of a fleet are just servers). "
+                            "router: the sharded control plane's scatter-"
+                            "gather frontend — no storage, no controllers; "
+                            "single-cluster requests proxy to the owning "
+                            "shard, wildcard list/watch merge across all "
+                            "shards (kcp_tpu/sharding/)")
+    start.add_argument("--shards", default="",
+                       help="router role: comma-separated [name=]url shard "
+                            "list (env KCP_SHARDS is the fallback), e.g. "
+                            "s0=http://h0:6443,s1=http://h1:6443")
     start.add_argument("--store-server", default="",
                        help="serve against another kcp-tpu server's "
                             "storage (the --etcd-servers analog): this "
@@ -136,6 +148,8 @@ def config_from_args(args) -> Config:
         store_server=args.store_server,
         store_token=args.store_token,
         store_ca_file=args.store_ca_file,
+        role=args.role,
+        shards=args.shards,
         poll_interval=args.poll_interval,
         import_poll_interval=args.poll_interval,
         authz=args.authz,

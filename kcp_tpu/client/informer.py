@@ -23,6 +23,7 @@ from typing import Awaitable, Callable, Iterable
 from ..apis.scheme import GVR
 from ..store.selectors import LabelSelector
 from ..store.store import ADDED, DELETED, MODIFIED, Event
+from ..utils import errors
 from .client import Client
 
 log = logging.getLogger(__name__)
@@ -89,6 +90,12 @@ class Informer:
         hinted interval (jittered up to +25% so a fleet of informers
         doesn't re-arrive in lockstep, capped so a bogus hint can't
         park the cache for minutes)."""
+        if isinstance(err, errors.GoneError):
+            # 410 Gone: the server said the watch window is EXPIRED —
+            # waiting cannot revive it, and every second of backoff is a
+            # second the cache serves stale state. Re-list immediately
+            # (the router's shard-death catchup path depends on this).
+            return 0.0
         hint = getattr(err, "retry_after", None)
         if hint is None:
             return self.rewatch_backoff

@@ -48,6 +48,10 @@ Injection points wired in this codebase:
     encode.cache                 store/store.py encode-once byte cache
                                  (``drop`` discards a cached entry on
                                  lookup, forcing the re-encode fallback)
+    router.proxy                 sharding/router.py router→shard relay
+                                 (error = a shard relay answers 503,
+                                 latency = a slow shard hop — the chaos
+                                 lever for shard-death drills)
 
 Sites call the module-level helpers, which are near-free no-ops when no
 injector is active (one global read).

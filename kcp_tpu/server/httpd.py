@@ -45,6 +45,10 @@ class Request:
     query: dict[str, list[str]]
     headers: dict[str, str]  # keys lower-cased
     body: bytes
+    # the request target exactly as it appeared on the request line
+    # (still percent-encoded, query included) — what a proxy (the shard
+    # router) forwards so relayed requests stay byte-identical
+    target: str = ""
 
     def param(self, name: str, default: str | None = None) -> str | None:
         vals = self.query.get(name)
@@ -307,4 +311,5 @@ class HttpServer:
             query=parse_qs(parts.query),
             headers=headers,
             body=body,
+            target=target,
         )

@@ -47,10 +47,13 @@ def _status_error(code: int, reason: str, message: str,
         "Forbidden": errors.ForbiddenError,
         "TooManyRequests": errors.TooManyRequestsError,
         "ServiceUnavailable": errors.UnavailableError,
+        "Expired": errors.GoneError,
+        "Gone": errors.GoneError,
     }
     cls = by_reason.get(reason)
     if cls is None:
         cls = {404: errors.NotFoundError, 409: errors.ConflictError,
+               410: errors.GoneError,
                422: errors.InvalidError, 400: errors.BadRequestError,
                403: errors.ForbiddenError,
                429: errors.TooManyRequestsError,
@@ -188,10 +191,11 @@ class RestWatch:
             reason = obj.get("reason", "")
             message = obj.get("message", "watch window expired")
             if code == 410 or reason == "Expired":
-                # 410 Gone — watch window expired. Surface it the way
-                # the in-process Watch does (ConflictError) so consumers
-                # know to re-list, not treat this as a benign close.
-                self.error = errors.ConflictError(message)
+                # 410 Gone — watch window expired. Typed GoneError (a
+                # ConflictError subclass, matching the in-process Watch)
+                # so consumers re-list NOW instead of backoff-retrying a
+                # watch that can never be served.
+                self.error = errors.GoneError(message)
             else:
                 # a relayed backend refusal (403 bad store token, 404,
                 # 429 throttling, ...): carry the real taxonomy so
@@ -338,8 +342,13 @@ class RestClient:
 
     # ------------------------------------------------------------ plumbing
 
-    def _request(self, method: str, path: str, body: dict | None = None) -> dict | None:
-        """One request over a kept-alive connection.
+    def _roundtrip(self, method: str, path: str, payload: bytes | None,
+                   headers: dict[str, str]):
+        """One request over a kept-alive connection; returns
+        ``(status, response, body bytes)`` — the already-read response
+        object is kept only for header access — without interpreting the
+        status: the JSON verbs raise through :func:`_raise_for_status`,
+        the shard router relays status/headers/body verbatim.
 
         Retry discipline: a send-stage failure on a *reused* connection is
         the classic stale-keep-alive case and is safe to retry for any
@@ -365,10 +374,6 @@ class RestClient:
             raise
         if delay:
             time.sleep(delay)
-        payload = json.dumps(body).encode() if body is not None else None
-        headers = {"Content-Type": "application/json"} if payload else {}
-        if self.token:
-            headers["Authorization"] = f"Bearer {self.token}"
         for attempt in (0, 1):
             reused = self._conn is not None
             if self._conn is None:
@@ -398,17 +403,46 @@ class RestClient:
                 self._breaker.record_failure()
                 raise
             self._breaker.record_success()
-            retry_after = None
-            if resp.status == 429:
-                # a throttling answer is the peer ALIVE (the breaker saw
-                # record_success above); surface the pacing hint instead
-                try:
-                    retry_after = float(resp.getheader("Retry-After") or "")
-                except ValueError:
-                    pass
-            _raise_for_status(resp.status, data, retry_after=retry_after)
-            return json.loads(data) if data else None
-        return None  # unreachable
+            return resp.status, resp, data
+        raise AssertionError("unreachable")
+
+    def _request(self, method: str, path: str, body: dict | None = None) -> dict | None:
+        """One JSON verb round trip (see :meth:`_roundtrip` for the retry
+        and circuit-breaker discipline); raises the mapped ApiError on
+        HTTP error statuses."""
+        payload = json.dumps(body).encode() if body is not None else None
+        headers = {"Content-Type": "application/json"} if payload else {}
+        if self.token:
+            headers["Authorization"] = f"Bearer {self.token}"
+        status, resp, data = self._roundtrip(method, path, payload, headers)
+        retry_after = None
+        if status == 429:
+            # a throttling answer is the peer ALIVE (the breaker saw
+            # record_success above); surface the pacing hint instead
+            try:
+                retry_after = float(resp.getheader("Retry-After") or "")
+            except ValueError:
+                pass
+        _raise_for_status(status, data, retry_after=retry_after)
+        return json.loads(data) if data else None
+
+    def request_raw(self, method: str, target: str,
+                    payload: bytes | None = None,
+                    headers: dict[str, str] | None = None,
+                    ) -> tuple[int, dict[str, str], bytes]:
+        """Raw relay round trip for proxies (the shard router): the
+        caller's target/body/headers go over the wire verbatim and the
+        response ``(status, headers, body)`` comes back uninterpreted —
+        HTTP error statuses are the peer ANSWERING and are relayed, not
+        raised. Transport failures and an open circuit breaker still
+        raise (the router maps those to a fail-fast 503). This client's
+        configured bearer token is added only when the caller forwarded
+        no Authorization of its own."""
+        h = dict(headers or {})
+        if self.token and not any(k.lower() == "authorization" for k in h):
+            h["Authorization"] = f"Bearer {self.token}"
+        status, resp, data = self._roundtrip(method, target, payload, h)
+        return status, dict(resp.getheaders()), data
 
     def close(self) -> None:
         if self._conn is not None:

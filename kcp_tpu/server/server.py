@@ -72,6 +72,11 @@ class Config:
     # docs/investigations/logical-clusters.md:83)
     pallas: bool = False  # serve the fused Pallas decide+match kernel
     # (ops/pallas_kernels.py) instead of the XLA lanes (single-device)
+    role: str = "shard"  # shard (a normal server — the default) | router
+    # (the sharded control plane's scatter-gather frontend: no storage,
+    # no controllers; every request routes over the shard ring)
+    shards: str = ""  # router role: comma-separated [name=]url shard list
+    # (KCP_SHARDS env is the fallback; see kcp_tpu/sharding/ring.py)
 
 
 class Server:
@@ -83,11 +88,61 @@ class Server:
         self.scheme = scheme or default_scheme()
         self.registry = registry or PhysicalRegistry()
         # resolve the install_controllers tri-state once (see Config):
-        # frontends serving someone else's storage default to serve-only
+        # frontends serving someone else's storage default to serve-only,
+        # and a router (no storage at all) can never run controllers
         self.install_controllers = (
-            self.config.install_controllers
+            False if self.config.role == "router"
+            else self.config.install_controllers
             if self.config.install_controllers is not None
             else not self.config.store_server)
+        if self.config.role == "router":
+            # scatter-gather frontend over a shard ring: no store, no
+            # controllers — requests relay to the owning shard(s). Authz
+            # is terminated BY THE SHARDS (bearer tokens pass through);
+            # enforcing it here too would need the router to share the
+            # shards' role objects it deliberately does not store.
+            from ..sharding import RouterHandler, ShardRing
+
+            if self.config.authz:
+                raise ValueError(
+                    "--authz with --role router: the router does not "
+                    "terminate authz — shards enforce it on every relayed "
+                    "request; run the router open and pass bearer tokens "
+                    "through")
+            if self.config.store_server:
+                raise ValueError("--store-server with --role router: a "
+                                 "router routes to --shards, not to a "
+                                 "storage backend")
+            ring = (ShardRing.from_spec(self.config.shards)
+                    if self.config.shards else ShardRing.from_env())
+            self.store = None
+            self.authenticator = None
+            self.handler = RouterHandler(
+                ring, token=self.config.store_token,
+                ca_file=self.config.store_ca_file)
+            self.certs = None
+            ssl_context = None
+            if self.config.durable:
+                # no WAL, but start() still renders admin.kubeconfig (and
+                # TLS persists pki/) under root_dir
+                os.makedirs(self.config.root_dir, exist_ok=True)
+            if self.config.tls:
+                from .certs import ServingCerts
+
+                cert_dir = (os.path.join(self.config.root_dir, "pki")
+                            if self.config.durable else None)
+                hosts = {self.config.listen_host, "127.0.0.1", "localhost"}
+                self.certs = ServingCerts.load_or_create(cert_dir,
+                                                         sorted(hosts))
+                ssl_context = self.certs.server_context()
+            self.http = HttpServer(self.handler, self.config.listen_host,
+                                   self.config.listen_port,
+                                   ssl_context=ssl_context)
+            self.client = None
+            self._controllers = []
+            self._post_start_hooks = []
+            self._stop = asyncio.Event()
+            return
         if self.config.store_server:
             # external storage: this process is a stateless frontend; the
             # backend's store owns RVs, conflicts, finalizers, and the WAL
@@ -296,6 +351,7 @@ class Server:
             self._installed_mesh = None
         await self.http.stop()
         self.handler.close()
-        if self.config.durable:
-            self.store.snapshot()
-        self.store.close()
+        if self.store is not None:
+            if self.config.durable:
+                self.store.snapshot()
+            self.store.close()

@@ -81,7 +81,12 @@ class ServerThread:
 
     def stop(self) -> None:
         if self._loop is not None and self.server is not None:
-            self._loop.call_soon_threadsafe(self.server.stop)
+            try:
+                self._loop.call_soon_threadsafe(self.server.stop)
+            except RuntimeError:
+                pass  # loop already closed: stop() is idempotent (chaos
+                # harnesses kill a shard mid-test and the fixture stops
+                # every thread again on teardown)
         if self._thread is not None:
             self._thread.join(timeout=30)
             self._thread = None

@@ -1,0 +1,102 @@
+"""Shard ring: consistent (rendezvous/HRW) hashing of logical clusters.
+
+The sharded control plane partitions by logical-cluster name — the unit
+the whole fork is organized around (SURVEY §0: many cheap tenant control
+planes keyed by cluster prefix; upstream kcp later shipped the same
+partition as shards). Rendezvous hashing (highest-random-weight) gives
+the two properties a shard ring needs with no virtual-node bookkeeping:
+
+- deterministic, coordination-free: every router (and every smart
+  client) computes the same owner from the shard list alone;
+- minimal movement: adding a shard reassigns only the keys whose
+  highest weight the new shard now holds (~1/N of the keyspace);
+  removing one reassigns only ITS keys.
+
+Weights come from blake2b over ``shard-name \\x00 cluster-name`` — a
+stable, process-independent hash (``hash()`` is per-process salted and
+would scatter ownership across restarts).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from dataclasses import dataclass
+
+DEFAULT_SHARDS_ENV = "KCP_SHARDS"
+
+
+@dataclass(frozen=True)
+class Shard:
+    """One shard server: a stable identity + its base URL."""
+
+    name: str
+    url: str
+
+
+def _weight(shard_name: str, cluster: str) -> int:
+    h = hashlib.blake2b(digest_size=8)
+    h.update(shard_name.encode("utf-8"))
+    h.update(b"\x00")
+    h.update(cluster.encode("utf-8"))
+    return int.from_bytes(h.digest(), "big")
+
+
+class ShardRing:
+    """An ordered, deduplicated set of shards with HRW ownership."""
+
+    def __init__(self, shards: list[Shard]):
+        if not shards:
+            raise ValueError("shard ring needs at least one shard")
+        seen: set[str] = set()
+        for s in shards:
+            if s.name in seen:
+                raise ValueError(f"duplicate shard name {s.name!r}")
+            seen.add(s.name)
+        self.shards: tuple[Shard, ...] = tuple(shards)
+
+    def __len__(self) -> int:
+        return len(self.shards)
+
+    def __iter__(self):
+        return iter(self.shards)
+
+    def owner_index(self, cluster: str) -> int:
+        """Index of the shard owning ``cluster`` (ties broken by name so
+        the choice is total even for colliding 64-bit weights)."""
+        best = 0
+        best_key = (_weight(self.shards[0].name, cluster), self.shards[0].name)
+        for i in range(1, len(self.shards)):
+            key = (_weight(self.shards[i].name, cluster), self.shards[i].name)
+            if key > best_key:
+                best, best_key = i, key
+        return best
+
+    def owner(self, cluster: str) -> Shard:
+        return self.shards[self.owner_index(cluster)]
+
+    @classmethod
+    def from_spec(cls, spec: str) -> "ShardRing":
+        """Parse a shard-list spec: comma-separated ``name=url`` entries
+        (bare URLs get ``shard<i>`` names). This is the ``KCP_SHARDS``
+        format and the ``kcp start --role router --shards`` argument."""
+        shards: list[Shard] = []
+        for i, entry in enumerate(s.strip() for s in spec.split(",")):
+            if not entry:
+                continue
+            name, sep, url = entry.partition("=")
+            if not sep:
+                name, url = f"shard{i}", entry
+            if "://" not in url:
+                raise ValueError(
+                    f"shard entry {entry!r}: expected [name=]http[s]://host:port")
+            shards.append(Shard(name.strip(), url.strip().rstrip("/")))
+        return cls(shards)
+
+    @classmethod
+    def from_env(cls) -> "ShardRing":
+        spec = os.environ.get(DEFAULT_SHARDS_ENV, "")
+        if not spec:
+            raise ValueError(
+                f"no shard list: set {DEFAULT_SHARDS_ENV} or pass --shards")
+        return cls.from_spec(spec)

@@ -1,0 +1,638 @@
+"""Scatter-gather router: the frontend of the sharded control plane.
+
+``kcp start --role router --shards s0=http://...,s1=http://...`` serves
+the SAME REST surface as a shard, but owns no storage: every request is
+routed over the :class:`~kcp_tpu.sharding.ring.ShardRing`.
+
+- **Single-cluster requests** (the overwhelming majority: every tenant
+  client, every informer bound to its own workspace) proxy straight
+  through to the owning shard — the raw request target and body go over
+  the wire verbatim and the shard's response bytes come back verbatim,
+  so PR 5's encode-once bytes are relayed without a single re-encode.
+  Transport uses :class:`~kcp_tpu.store.remote.ConnectionPool` (bounded
+  kept-alive RestClients per shard, one shared per-peer
+  :class:`~kcp_tpu.utils.circuit.CircuitBreaker`): a dead shard trips
+  once and fails fast 503 instead of stacking 30 s connect timeouts.
+- **Wildcard lists** scatter to every shard and merge by byte-splicing
+  the shards' ``items`` arrays into one envelope — per-object bytes are
+  exactly what the owning shard serialized. The merged list's
+  ``resourceVersion`` is a **vector RV** (:mod:`.rvmap`): the per-shard
+  RVs packed into one opaque integer.
+- **Wildcard watches** merge N per-shard streams. Event lines relay
+  byte-verbatim; the router parses each line only to keep per-shard
+  position (vector-RV bookkeeping). A resume (``?resourceVersion=``)
+  decodes the vector and resumes each shard from ITS OWN honest
+  ``since_rv``; a non-vector RV answers 410 Gone (re-list — never
+  guess). Shard-local BOOKMARKs are absorbed into the position map;
+  client-facing BOOKMARKs carry the vector. A shard stream dying ends
+  the merged stream with a terminal in-stream 410 Status — the PR 2
+  fault discipline: clients re-list and resume from a fresh vector.
+- **Wildcard writes** route through the one copy of the wildcard rule
+  (:func:`~kcp_tpu.utils.routing.resolve_write_cluster`) and then the
+  ring; a write without ``metadata.clusterName`` is a 400, exactly as
+  on a shard.
+
+``router.proxy`` is a KCP_FAULTS injection point (error/latency on the
+relay path). Metrics: ``router_proxy_seconds``,
+``router_scatter_fanout``, ``router_shard_unavailable_total``,
+``router_watch_resumes_total``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import http.client
+import json
+import logging
+import os
+import re
+import time
+from concurrent.futures import ThreadPoolExecutor
+from urllib.parse import quote, urlencode, urlsplit
+
+from ..faults import maybe_fail
+from ..server.handler import CLUSTER_HEADER, DEFAULT_CLUSTER, _error_response, _status_body
+from ..server.httpd import Request, Response, StreamResponse
+from ..server.rest import RestWatch
+from ..store.remote import ConnectionPool
+from ..store.store import WILDCARD
+from ..utils import errors
+from ..utils.routing import resolve_write_cluster
+from ..utils.trace import REGISTRY
+from .ring import ShardRing
+from .rvmap import decode_rvmap, encode_rvmap
+
+log = logging.getLogger(__name__)
+
+_ITEMS_MARKER = b'"items": ['
+_RV_RE = re.compile(rb'"resourceVersion": "(\d+)"')
+
+
+class _TapWatch(RestWatch):
+    """A per-shard watch stream that keeps each event line's RAW bytes.
+
+    The router relays lines verbatim (zero re-encode — the whole point
+    of riding the shards' encode-once serving) while parsing each line
+    once for vector-RV bookkeeping. Queue items are ``(raw, msg)``
+    pairs; the ``None`` sentinel still marks end-of-stream, and
+    ``self.error`` still carries a non-2xx upstream response.
+    """
+
+    def _feed(self, chunk: bytes) -> None:
+        lines = (self._buf + self._decoder.decode(chunk)).split("\n")
+        self._buf = lines.pop()
+        for line in lines:
+            if line.strip():
+                self._events.put_nowait(
+                    (line.encode("utf-8") + b"\n", json.loads(line)))
+
+    async def next(self) -> tuple[bytes, dict] | None:
+        """Next ``(raw_line, parsed)`` pair, or None at end-of-stream."""
+        self._ensure_started()
+        if self._closed and self._events.empty():
+            return None
+        item = await self._events.get()
+        if item is None:
+            self._events.put_nowait(None)
+            return None
+        return item
+
+    def drain_raw(self) -> list[tuple[bytes, dict]]:
+        out: list[tuple[bytes, dict]] = []
+        while not self._events.empty():
+            item = self._events.get_nowait()
+            if item is None:
+                self._events.put_nowait(None)
+                break
+            out.append(item)
+        return out
+
+
+class RouterHandler:
+    """Routes parsed HTTP requests onto a shard ring (no local store)."""
+
+    def __init__(self, ring: ShardRing, version_info: dict | None = None,
+                 token: str = "", ca_data: bytes | str | None = None,
+                 ca_file: str | None = None, pool_cap: int | None = None,
+                 bookmark_every: float | None = None):
+        self.ring = ring
+        self.version_info = version_info or {
+            "major": "0", "minor": "1", "gitVersion": "kcp-tpu-v0.1.0",
+            "role": "router", "shards": len(ring)}
+        self.ready = False
+        cap = pool_cap if pool_cap is not None else int(
+            os.environ.get("KCP_ROUTER_POOL", "8"))
+        self.bookmark_every = bookmark_every if bookmark_every is not None \
+            else float(os.environ.get("KCP_ROUTER_BOOKMARK_S", "5"))
+        # router → shard auth: the CLIENT's bearer token is forwarded
+        # when present (shards terminate authz; the router stays a dumb
+        # pipe), `token` is the fallback credential for routerless
+        # callers (health scatters)
+        self._pools = [ConnectionPool(s.url, token=token, ca_data=ca_data,
+                                      ca_file=ca_file, cap=cap)
+                       for s in ring]
+        # scatter concurrency: every shard must be reachable in parallel
+        # or a wildcard fan-out serializes on the slowest round trip
+        self._exec = ThreadPoolExecutor(
+            max_workers=max(8, 2 * len(ring)),
+            thread_name_prefix="router-io")
+        self._proxy_seconds = REGISTRY.histogram(
+            "router_proxy_seconds", "one router→shard relay round trip")
+        self._fanout = REGISTRY.histogram(
+            "router_scatter_fanout", "shards touched per scatter-gather")
+        self._unavailable = REGISTRY.counter(
+            "router_shard_unavailable_total",
+            "relay attempts that found a shard unreachable (transport "
+            "failure or open circuit breaker)")
+        self._resumes = REGISTRY.counter(
+            "router_watch_resumes_total",
+            "merged wildcard watches resumed from a decoded vector RV")
+
+    def close(self) -> None:
+        self._exec.shutdown(wait=False, cancel_futures=True)
+        for p in self._pools:
+            p.close()
+
+    # ----------------------------------------------------------- plumbing
+
+    def _shard_call(self, idx: int, method: str, target: str,
+                    payload: bytes | None, headers: dict[str, str],
+                    ) -> tuple[int, dict[str, str], bytes]:
+        """One raw relay round trip to shard ``idx`` (executor thread)."""
+        delay = maybe_fail("router.proxy")
+        if delay:
+            time.sleep(delay)
+        pool = self._pools[idx]
+        t0 = time.perf_counter()
+        try:
+            with pool.client() as c:
+                return c.request_raw(method, target, payload, headers)
+        except errors.UnavailableError:
+            # breaker fail-fast: already the right type, just count it
+            self._unavailable.inc()
+            raise
+        except (ConnectionError, OSError, TimeoutError,
+                http.client.HTTPException) as e:
+            self._unavailable.inc()
+            raise errors.UnavailableError(
+                f"shard {self.ring.shards[idx].name} unreachable: {e}") from e
+        finally:
+            self._proxy_seconds.observe(time.perf_counter() - t0)
+
+    async def _call(self, idx: int, method: str, target: str,
+                    payload: bytes | None, headers: dict[str, str]):
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            self._exec, self._shard_call, idx, method, target, payload, headers)
+
+    async def _scatter(self, method: str, target: str,
+                       headers: dict[str, str]):
+        """The same request against every shard, in parallel. Raises
+        UnavailableError if ANY shard is unreachable — a partial scatter
+        cannot honestly claim cross-shard answers."""
+        self._fanout.observe(len(self.ring))
+        return await asyncio.gather(
+            *(self._call(i, method, target, None, headers)
+              for i in range(len(self.ring))))
+
+    @staticmethod
+    def _fwd_headers(req: Request) -> dict[str, str]:
+        h = {}
+        for k, out in (("authorization", "Authorization"),
+                       ("content-type", "Content-Type"),
+                       ("accept", "Accept")):
+            v = req.headers.get(k)
+            if v:
+                h[out] = v
+        return h
+
+    @staticmethod
+    def _relay(status: int, rheaders: dict[str, str], body: bytes) -> Response:
+        lower = {k.lower(): v for k, v in rheaders.items()}
+        resp = Response(status=status, body=body,
+                        content_type=lower.get("content-type",
+                                               "application/json"))
+        if "retry-after" in lower:
+            resp.headers["Retry-After"] = lower["retry-after"]
+        return resp
+
+    @staticmethod
+    def _parse_resource(segs: list[str]):
+        """Path-shape parse (no scheme resolution — shards resolve):
+        ``(group, version, namespace, resource, name, subresource)`` or
+        None for discovery / non-resource paths."""
+        if segs[0] == "api":
+            group, rest = "", segs[1:]
+        elif segs[0] == "apis":
+            if len(segs) < 3:
+                return None
+            group, rest = segs[1], segs[2:]
+        else:
+            return None
+        if len(rest) < 2:
+            return None  # /api/v1 and /apis/g/v are discovery
+        _version, rest = rest[0], rest[1:]
+        namespace = ""
+        if rest[0] == "namespaces" and len(rest) >= 3:
+            namespace, rest = rest[1], rest[2:]
+        resource, rest = rest[0], rest[1:]
+        name = rest[0] if rest else None
+        sub = rest[1] if len(rest) > 1 else None
+        if len(rest) > 2:
+            return None
+        return (group, _version, namespace, resource, name, sub)
+
+    # ------------------------------------------------------------ routing
+
+    async def __call__(self, req: Request) -> Response | StreamResponse:
+        segs = [s for s in req.path.split("/") if s]
+        cluster = req.headers.get(CLUSTER_HEADER, DEFAULT_CLUSTER)
+        cluster_in_path = False
+        if len(segs) >= 2 and segs[0] == "clusters":
+            cluster = segs[1]
+            segs = segs[2:]
+            cluster_in_path = True
+        if not segs:
+            return Response.of_json(
+                {"paths": ["/api", "/apis", "/healthz", "/version"]})
+        head = segs[0]
+        if head in ("healthz", "livez"):
+            return Response(body=b"ok", content_type="text/plain")
+        if head == "readyz":
+            if self.ready:
+                return Response(body=b"ok", content_type="text/plain")
+            return Response(status=500, body=b"not ready",
+                            content_type="text/plain")
+        if head == "metrics":
+            return Response(body=REGISTRY.expose().encode("utf-8"),
+                            content_type="text/plain; version=0.0.4")
+        try:
+            if head == "version":
+                return await self._version(req)
+            if head == "clusters" and len(segs) == 1:
+                return await self._clusters(req)
+            # everything below is cluster-scoped: normalize the cluster
+            # into the forwarded target so shards never see our header
+            target = req.target if cluster_in_path else (
+                "/clusters/" + quote(cluster, safe="*") + req.target)
+            shape = self._parse_resource(segs)
+            is_watch = (req.method == "GET" and shape is not None
+                        and shape[4] is None
+                        and req.param("watch") in ("true", "1"))
+            if cluster != WILDCARD:
+                idx = self.ring.owner_index(cluster)
+                if is_watch:
+                    return self._stream_proxy(idx, target, req)
+                status, h, body = await self._call(
+                    idx, req.method, target, req.body or None,
+                    self._fwd_headers(req))
+                return self._relay(status, h, body)
+            return await self._wildcard(req, segs, shape, is_watch, target)
+        except errors.ApiError as e:
+            return _error_response(e)
+
+    # ----------------------------------------------------------- wildcard
+
+    async def _wildcard(self, req: Request, segs: list[str], shape,
+                        is_watch: bool, target: str):
+        if shape is None:
+            # discovery / openapi: identical on every shard (same binary,
+            # same scheme) — serve from the first reachable one
+            return await self._any_shard(req, target)
+        _g, _v, _ns, _res, name, _sub = shape
+        headers = self._fwd_headers(req)
+        if req.method == "GET" and name is None:
+            if is_watch:
+                return self._merged_watch(req, target)
+            return await self._scatter_list(req, target)
+        if req.method == "GET" and name is not None:
+            _idx, (s, h, b) = await self._scatter_named(req, target)
+            return self._relay(s, h, b)
+        if req.method in ("POST", "PUT"):
+            try:
+                obj = json.loads(req.body) if req.body else None
+            except ValueError as e:
+                raise errors.BadRequestError(f"malformed JSON body: {e}") from e
+            if not isinstance(obj, dict):
+                raise errors.BadRequestError("body must be a JSON object")
+            # the ONE copy of the wildcard write rule, then the ring; the
+            # shard re-resolves the same rule to the same cluster
+            wc = resolve_write_cluster(WILDCARD, obj, errors.BadRequestError)
+            idx = self.ring.owner_index(wc)
+            status, h, body = await self._call(
+                idx, req.method, target, req.body, headers)
+            return self._relay(status, h, body)
+        if req.method == "DELETE" and name is not None:
+            # resolve the unique owner with a read scatter FIRST: a
+            # wildcard DELETE forwarded to every shard would delete any
+            # same-named object that is unique *within* its shard even
+            # when it is ambiguous across the fleet
+            idx, (s, h, b) = await self._scatter_named(req, target)
+            if idx < 0:
+                return self._relay(s, h, b)
+            status, h2, b2 = await self._call(idx, "DELETE", target, None,
+                                              headers)
+            return self._relay(status, h2, b2)
+        raise errors.BadRequestError(
+            f"unsupported method {req.method} for {req.path}")
+
+    async def _any_shard(self, req: Request, target: str) -> Response:
+        last: Exception | None = None
+        for i in range(len(self.ring)):
+            try:
+                status, h, body = await self._call(
+                    i, req.method, target, req.body or None,
+                    self._fwd_headers(req))
+                return self._relay(status, h, body)
+            except errors.UnavailableError as e:
+                last = e
+        assert last is not None
+        raise last
+
+    async def _scatter_named(self, req: Request, target: str):
+        """Resolve a wildcard single-object read across shards: returns
+        ``(owner_index, (status, headers, body))`` with owner_index -1
+        when there is no unique owner (the triple is then the honest
+        error response to relay)."""
+        results = await self._scatter("GET", target, self._fwd_headers(req))
+        hits = [i for i, (s, _h, _b) in enumerate(results) if 200 <= s < 300]
+        if len(hits) == 1:
+            return hits[0], results[hits[0]]
+        if len(hits) > 1:
+            names = [self.ring.shards[i].name for i in hits]
+            raise errors.BadRequestError(
+                f"object is ambiguous across shards {names}")
+        # no shard owns it: relay a shard-local ambiguity (400) over any
+        # other error over the plain 404
+        for s, h, b in results:
+            if s == 400:
+                return -1, (s, h, b)
+        for s, h, b in results:
+            if s != 404:
+                return -1, (s, h, b)
+        return -1, results[0]
+
+    async def _scatter_list(self, req: Request, target: str) -> Response:
+        results = await self._scatter("GET", target, self._fwd_headers(req))
+        for s, h, b in results:
+            if s >= 400:
+                # one refusal (authz, unknown resource) refuses the merge
+                return self._relay(s, h, b)
+        bodies = [b for _s, _h, b in results]
+        merged = self._merge_lists(bodies)
+        if merged is None:
+            merged = self._merge_lists_dict(bodies)
+        return Response(body=merged)
+
+    def _merge_lists(self, bodies: list[bytes]) -> bytes | None:
+        """Byte-splice shard list bodies into one: per-object bytes are
+        exactly what each owning shard serialized (encode-once bytes
+        relay untouched); only the envelope's resourceVersion is
+        rewritten to the vector RV. None when a body isn't a standard
+        list shape (Table renderings take the dict path)."""
+        spans: list[bytes] = []
+        rvs: list[int] = []
+        head0 = None
+        m0 = None
+        for body in bodies:
+            i = body.find(_ITEMS_MARKER)
+            if i < 0 or not body.endswith(b"]}"):
+                return None
+            head = body[:i + len(_ITEMS_MARKER)]
+            m = _RV_RE.search(head)
+            if m is None:
+                return None
+            rvs.append(int(m.group(1)))
+            if head0 is None:
+                head0, m0 = head, m
+            span = body[i + len(_ITEMS_MARKER):-2]
+            if span:
+                spans.append(span)
+        assert head0 is not None and m0 is not None
+        vec = str(encode_rvmap(rvs)).encode()
+        head = head0[:m0.start(1)] + vec + head0[m0.end(1):]
+        return head + b", ".join(spans) + b"]}"
+
+    def _merge_lists_dict(self, bodies: list[bytes]) -> bytes:
+        docs = [json.loads(b) for b in bodies]
+        out = docs[0]
+        key = "rows" if out.get("kind") == "Table" else "items"
+        merged: list = []
+        for d in docs:
+            merged.extend(d.get(key) or [])
+        out[key] = merged
+        rvs = [int((d.get("metadata") or {}).get("resourceVersion", "0"))
+               for d in docs]
+        out.setdefault("metadata", {})["resourceVersion"] = str(
+            encode_rvmap(rvs))
+        return json.dumps(out).encode()
+
+    # ------------------------------------------------------ server-global
+
+    async def _version(self, req: Request) -> Response:
+        body = dict(self.version_info)
+        try:
+            results = await self._scatter("GET", "/version",
+                                          self._fwd_headers(req))
+            rvs = []
+            for s, _h, b in results:
+                if s >= 400:
+                    raise ValueError(f"shard /version answered {s}")
+                rv = json.loads(b).get("resourceVersion")
+                if rv is None:
+                    raise ValueError("shard withheld resourceVersion")
+                rvs.append(int(rv))
+            body["resourceVersion"] = str(encode_rvmap(rvs))
+        except (ValueError, errors.ApiError):
+            # version fields stay public; the vector RV is simply omitted
+            # when any shard withholds its RV or is unreachable
+            pass
+        return Response.of_json(body)
+
+    async def _clusters(self, req: Request) -> Response:
+        results = await self._scatter("GET", "/clusters",
+                                      self._fwd_headers(req))
+        for s, h, b in results:
+            if s >= 400:
+                return self._relay(s, h, b)
+        names = sorted({c for _s, _h, b in results
+                        for c in json.loads(b).get("clusters", [])})
+        return Response.of_json({"clusters": names})
+
+    # -------------------------------------------------------------- watch
+
+    def _tap_watch(self, idx: int, target: str, req: Request) -> _TapWatch:
+        pool = self._pools[idx]
+        parts = urlsplit(pool.base_url)
+        host = parts.hostname or "127.0.0.1"
+        port = parts.port or (443 if parts.scheme == "https" else 80)
+        auth = req.headers.get("authorization", "")
+        token = auth[7:] if auth.lower().startswith("bearer ") else pool.token
+        return _TapWatch(host, port, target, "", token=token,
+                         ssl_context=pool.ssl_context)
+
+    def _stream_proxy(self, idx: int, target: str, req: Request) -> StreamResponse:
+        """Single-cluster watch: a byte-verbatim stream relay to the
+        owning shard — every line (events, bookmarks, in-stream errors)
+        passes through untouched, so resume RVs stay shard-local and
+        honest (the ring maps the cluster back to the same shard)."""
+        shard = self.ring.shards[idx]
+
+        async def produce(stream: StreamResponse) -> None:
+            watch = self._tap_watch(idx, target, req)
+            try:
+                while True:
+                    item = await watch.next()
+                    if item is None:
+                        err = watch.error
+                        if err is not None:
+                            # non-2xx upstream response: surface it
+                            # in-stream like every other relay refusal
+                            await stream.send_json({
+                                "type": "ERROR",
+                                "object": _status_body(err.code, err.reason,
+                                                       err.message)})
+                        return
+                    batch = [item[0]]
+                    batch.extend(raw for raw, _m in watch.drain_raw())
+                    await stream.send_raw_many(batch)
+            except errors.UnavailableError as e:
+                self._unavailable.inc()
+                await stream.send_json({
+                    "type": "ERROR",
+                    "object": _status_body(503, "ServiceUnavailable",
+                                           f"shard {shard.name}: {e.message}")})
+            finally:
+                watch.close()
+
+        return StreamResponse(produce)
+
+    def _watch_target(self, req: Request, target: str,
+                      since_rv: int | None) -> str:
+        """Rebuild a per-shard watch target: the shard's own resume RV
+        replaces the client's, and shard-side bookmarks are always on —
+        they feed the vector-RV position map even when the client asked
+        for none."""
+        path, _sep, _q = target.partition("?")
+        params = {k: v[0] for k, v in req.query.items()}
+        if since_rv is not None:
+            params["resourceVersion"] = str(since_rv)
+        else:
+            params.pop("resourceVersion", None)
+        params["allowWatchBookmarks"] = "true"
+        return path + "?" + urlencode(params, quote_via=quote)
+
+    def _merged_watch(self, req: Request, target: str) -> StreamResponse:
+        n = len(self.ring)
+        since = req.param("resourceVersion")
+        want_bookmarks = req.param("allowWatchBookmarks") in ("true", "1")
+
+        async def produce(stream: StreamResponse) -> None:
+            rvs: list[int] | None = None
+            if since:
+                try:
+                    value = int(since)
+                except ValueError:
+                    await stream.send_json({
+                        "type": "ERROR",
+                        "object": _status_body(
+                            400, "BadRequest",
+                            f"malformed resourceVersion {since!r}")})
+                    return
+                rvs = decode_rvmap(value, n)
+                if rvs is None:
+                    # a scalar (or foreign-ring) RV carries no per-shard
+                    # positions — resuming from it would either replay or
+                    # skip arbitrarily on every shard. Honest answer: 410,
+                    # client re-lists and gets a vector RV.
+                    await stream.send_json({
+                        "type": "ERROR",
+                        "object": _status_body(
+                            410, "Expired",
+                            f"resourceVersion {since} is not a vector RV "
+                            f"for this {n}-shard ring; re-list")})
+                    return
+                self._resumes.inc()
+            pos = list(rvs) if rvs else [0] * n
+            known = [rvs is not None] * n
+            q: asyncio.Queue = asyncio.Queue()
+            watches: list[_TapWatch] = []
+            pumps: list[asyncio.Task] = []
+            try:
+                for i in range(n):
+                    t = self._watch_target(
+                        req, target, rvs[i] if rvs else None)
+                    watches.append(self._tap_watch(i, t, req))
+
+                async def pump(i: int, w: _TapWatch) -> None:
+                    while True:
+                        item = await w.next()
+                        await q.put((i, item))
+                        if item is None:
+                            return
+
+                pumps = [asyncio.ensure_future(pump(i, w))
+                         for i, w in enumerate(watches)]
+                while True:
+                    try:
+                        i, item = await asyncio.wait_for(
+                            q.get(), timeout=self.bookmark_every)
+                    except asyncio.TimeoutError:
+                        # idle: a vector bookmark, but only once every
+                        # shard has reported an honest position — a
+                        # guessed 0 would rewind a resuming client into
+                        # a replay (or a 410) it never asked for
+                        if want_bookmarks and all(known):
+                            await stream.send_json({
+                                "type": "BOOKMARK",
+                                "object": {"kind": "Bookmark", "metadata": {
+                                    "resourceVersion": str(encode_rvmap(pos))}},
+                            })
+                        continue
+                    if item is None:
+                        # shard stream died (process death, connection
+                        # loss): merged coverage is gone — terminal 410 so
+                        # the client re-lists and resumes from a fresh
+                        # vector (PR 2 discipline: fail loudly in-stream,
+                        # never silently serve a partial fleet)
+                        err = watches[i].error
+                        msg = f"shard {self.ring.shards[i].name} watch ended"
+                        if err is not None:
+                            msg += f": {getattr(err, 'message', err)}"
+                        await stream.send_json({
+                            "type": "ERROR",
+                            "object": _status_body(410, "Expired",
+                                                   msg + "; re-list required")})
+                        return
+                    raw, msg = item
+                    mtype = msg.get("type")
+                    meta = (msg.get("object") or {}).get("metadata") or {}
+                    try:
+                        rv = int(meta.get("resourceVersion", "0"))
+                    except (TypeError, ValueError):
+                        rv = 0
+                    if mtype == "BOOKMARK":
+                        # shard-local progress marker: absorbed into the
+                        # position map, never relayed (its scalar RV
+                        # would poison the client's resume)
+                        if rv:
+                            pos[i] = max(pos[i], rv)
+                            known[i] = True
+                        continue
+                    if mtype == "ERROR":
+                        # the shard refused or expired this stream:
+                        # relay its typed Status verbatim and end — the
+                        # merge cannot continue with partial coverage
+                        await stream.send_raw_many([raw])
+                        return
+                    if rv:
+                        pos[i] = max(pos[i], rv)
+                        known[i] = True
+                    await stream.send_raw_many([raw])
+            finally:
+                for p in pumps:
+                    p.cancel()
+                for w in watches:
+                    w.close()
+
+        return StreamResponse(produce)

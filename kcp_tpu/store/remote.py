@@ -29,6 +29,7 @@ backend's response) — informer relists handle both shapes.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 from collections import OrderedDict
 
@@ -36,6 +37,81 @@ from .selectors import LabelSelector
 from .store import WILDCARD
 
 DEFAULT_CLUSTER = "default"
+
+
+class ConnectionPool:
+    """Bounded pool of RestClients for ONE peer (a shard behind the
+    router, a storage backend): each client owns one kept-alive
+    connection and is not thread-safe, so concurrency = clients. All
+    clients are ``scoped()`` clones of one prototype, which makes the
+    per-peer circuit breaker and the discovery cache SHARED — a dead
+    peer trips once and every borrowed client fails fast.
+
+    ``client()`` is a context manager: borrow (blocking once ``cap``
+    clients are all in flight — backpressure instead of unbounded
+    sockets), use, return. Used by the shard router for scatter-gather
+    fan-out, where N shards × M concurrent requests would otherwise
+    serialize on one connection per shard."""
+
+    def __init__(self, base_url: str, token: str = "",
+                 ca_data: bytes | str | None = None,
+                 ca_file: str | None = None, cap: int = 8,
+                 cluster: str = WILDCARD):
+        # deferred import: store/ must not import server/ at module load
+        from ..server.rest import RestClient
+
+        self._proto = RestClient(base_url, cluster=cluster, token=token,
+                                 ca_data=ca_data, ca_file=ca_file)
+        self._cap = max(1, cap)
+        self._cond = threading.Condition()
+        self._free = [self._proto]
+        self._total = 1
+        self._closed = False
+        self.base_url = base_url
+
+    @property
+    def breaker(self):
+        """The peer's shared circuit breaker (one per pool)."""
+        return self._proto._breaker
+
+    @property
+    def ssl_context(self):
+        return self._proto._ssl
+
+    @property
+    def token(self) -> str:
+        return self._proto.token
+
+    @contextlib.contextmanager
+    def client(self):
+        with self._cond:
+            while not self._free and self._total >= self._cap:
+                if not self._cond.wait(timeout=30):
+                    raise TimeoutError(
+                        f"connection pool for {self.base_url} exhausted "
+                        f"({self._cap} clients all in flight for 30s)")
+            if self._free:
+                c = self._free.pop()
+            else:
+                c = self._proto.scoped(self._proto.cluster)
+                self._total += 1
+        try:
+            yield c
+        finally:
+            with self._cond:
+                if self._closed:
+                    c.close()
+                else:
+                    self._free.append(c)
+                self._cond.notify()
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            free, self._free = self._free, []
+            self._cond.notify_all()
+        for c in free:
+            c.close()
 
 
 class RemoteStore:

@@ -88,6 +88,7 @@ from ..faults import maybe_fail, should_drop
 from ..utils.errors import (
     AlreadyExistsError,
     ConflictError,
+    GoneError,
     InvalidError,
     NotFoundError,
 )
@@ -1018,7 +1019,10 @@ class LogicalStore:
             # pre-restart RV against a WAL-restored store) and must re-list
             oldest = self._history[0].rv if self._history else None
             if oldest is None or oldest > since_rv + 1:
-                raise ConflictError(
+                # typed 410 (GoneError subclasses ConflictError, so the
+                # pre-typed except clauses keep working): consumers
+                # re-list immediately instead of backoff-retrying
+                raise GoneError(
                     f"watch window expired: requested rv {since_rv}, oldest retained {oldest}"
                 )
             # reversed tail-scan: resume RVs are recent (informers resume

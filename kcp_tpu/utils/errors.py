@@ -37,6 +37,19 @@ class ConflictError(ApiError):
     reason = "Conflict"
 
 
+class GoneError(ConflictError):
+    """410 Gone: the requested watch/list window has expired server-side
+    (the apiserver's "too old resource version"). Subclasses
+    :class:`ConflictError` so existing expired-window handling (which
+    predates the dedicated type) keeps catching it; consumers that can
+    react smarter — informers, the shard router's catchup path — match
+    this type and re-list *immediately* instead of backoff-retrying a
+    watch that can never be served."""
+
+    code = 410
+    reason = "Expired"
+
+
 class InvalidError(ApiError):
     code = 422
     reason = "Invalid"
@@ -111,6 +124,11 @@ def is_already_exists(err: BaseException) -> bool:
 
 def is_too_many_requests(err: BaseException) -> bool:
     return isinstance(err, TooManyRequestsError)
+
+
+def is_gone(err: BaseException) -> bool:
+    """True for an expired watch/list window (410): re-list now."""
+    return isinstance(err, GoneError)
 
 
 def retry_after_hint(err: BaseException) -> float | None:

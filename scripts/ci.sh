@@ -111,6 +111,34 @@ print("admission smoke ok: overhead %.2f%% (direct %.2f%%) | quiet p99 ratio"
          st["quiet_p99_ratio"], st["flood_429"], st["flood_sent"]))
 '
 
+echo "== sharded: 2-shard fleet smoke (capacity scaling, shard-kill drill)"
+# real kcp subprocesses: 2 shards + a --role router frontend. Gates the
+# shared-nothing capacity floor (time-sliced per-shard rates — honest on
+# 1-core CI hosts; see docs/operations.md "Benchmarking"), the router's
+# fail-fast 503 once the breaker trips on a SIGKILLed shard, the merged
+# watch's terminal in-stream 410, and zero acked writes lost after the
+# WAL-restored restart + relist catchup.
+sh_line=$(KCP_BENCH_SHARD_FLEETS=1,2 KCP_BENCH_SHARD_SECONDS=1.5 \
+    KCP_BENCH_SHARD_CLUSTERS=16 KCP_BENCH_SHARD_EVENTS=12 \
+    python bench.py --sharded | tail -1)
+printf '%s\n' "$sh_line" | python -c '
+import json, sys
+r = json.loads(sys.stdin.readline())
+sb = r["sharded_bench"]
+kill = sb["kill"]
+cap = sb["capacity_speedup"]["2"]
+# floor 1.6x: a skewed ring or cross-shard write traffic drags the
+# shared-nothing capacity sum toward 1x; near-linear is ~2x
+assert cap >= 1.6, "2-shard capacity speedup %sx < 1.6x floor" % cap
+assert kill["watch_terminal_410"], "merged watch did not end with 410: %s" % kill
+assert kill["failfast_ms"] < 1000, "breaker not failing fast: %s" % kill
+assert kill["lost_after_catchup"] == 0, "lost writes after catchup: %s" % kill
+print("sharded smoke ok: capacity %sx @2 shards (concurrent %sx on %s cpu)"
+      " | kill: 410 in %sms, fail-fast %sms, %d acked / 0 lost"
+      % (cap, sb["concurrent_speedup"]["2"], sb["host_cpus"],
+         kill["watch_410_ms"], kill["failfast_ms"], kill["acked_writes"]))
+'
+
 if [[ "$fast" == "0" ]]; then
     echo "== demo: both golden scenarios, checked against committed output"
     python contrib/demo/run_demo.py all --check
