@@ -48,6 +48,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import threading
+import time
 
 import numpy as np
 
@@ -127,6 +128,12 @@ class QuotaLedger:
         # resync has to revisit when quota objects disappear
         self._limited_clusters: set[str] = set()
         self._store = None
+        # device-side usage lane: per-key live-row counts computed by
+        # the fused fleet batch's per-segment counters (FusedCore
+        # forwards them on every collect) — admission accounting riding
+        # the device batch instead of a host-side pass
+        self._device_counts: dict[int, int] = {}
+        self._device_stamp = float("-inf")
 
     # ---------------------------------------------------------- interning
 
@@ -250,6 +257,68 @@ class QuotaLedger:
         clusters = set(store._buckets.get(QUOTA_RESOURCE, {}))
         for cluster in clusters | set(self._limited_clusters):
             self.resync_limits(store, cluster)
+
+    # ----------------------------------------------- device-count lane
+
+    def ingest_device_counts(self, counts: dict[tuple[str, str], int]) -> None:
+        """Fold the fleet batch's device-side per-segment counters into
+        the ledger's device-usage lane.
+
+        ``counts`` maps (cluster, resource) to the number of live synced
+        rows the fused step counted for that key THIS tick — computed on
+        device as a segment-sum riding the same batch as the reconcile
+        decisions, so it costs the serving path nothing. The lane feeds
+        (1) the ``quota_usage_device`` gauge, (2) drift detection
+        (``quota_device_drift_total`` counts keys where the device lane
+        and the ledger disagree — a synced-but-miscounted tenant), and
+        (3) the recount controller's fast path: when every limited key
+        has a fresh, agreeing device count, the periodic host-side
+        recount walk is skipped. The store-derived host recount remains
+        the repair authority — a section's device count equals the store
+        count exactly when every object of the resource is labeled for
+        sync, and any disagreement falls back to the host pass."""
+        now = time.monotonic()
+        drift = 0
+        with self._lock:
+            for key, n in counts.items():
+                i = self._slot(*key)
+                self._device_counts[i] = int(n)
+                if self._usage.item(i) != n:
+                    drift += 1
+            self._device_stamp = now
+        REGISTRY.gauge(
+            "quota_usage_device",
+            "live synced rows counted on-device by the fleet batch's "
+            "per-segment counters").set(sum(counts.values()))
+        if drift:
+            REGISTRY.counter(
+                "quota_device_drift_total",
+                "device-counted keys disagreeing with ledger usage").inc(
+                drift)
+
+    def device_usage_of(self, cluster: str, resource: str) -> int | None:
+        """The device-lane count for a key (None = never reported)."""
+        with self._lock:
+            i = self._idx.get((cluster, resource))
+            return self._device_counts.get(i) if i is not None else None
+
+    def device_counts_agree(self, max_age: float) -> bool:
+        """True when every limited key has a device-lane count no older
+        than ``max_age`` seconds that equals ledger usage — the recount
+        controller's evidence that accounting is riding the fleet batch
+        and the host-side recount walk can be skipped this cycle."""
+        with self._lock:
+            if time.monotonic() - self._device_stamp > max_age:
+                return False
+            limited = [i for i in range(len(self._keys))
+                       if self._hard[i] != UNLIMITED]
+            if not limited:
+                return False
+            for i in limited:
+                dc = self._device_counts.get(i)
+                if dc is None or dc != self._usage.item(i):
+                    return False
+        return True
 
     # ----------------------------------------------------------- repair
 
@@ -379,7 +448,17 @@ class UsageRecountController:
     async def _recount_loop(self) -> None:
         while True:
             await asyncio.sleep(self.period)
-            self.ledger.recount(self.store)
+            if self.ledger.device_counts_agree(2 * self.period):
+                # admission accounting rode the fused fleet batch this
+                # cycle: every limited key has a fresh device-side count
+                # agreeing with the ledger, so the host-side recount
+                # walk has nothing to repair — skip it (metered)
+                REGISTRY.counter(
+                    "quota_recount_skipped_total",
+                    "periodic host recounts skipped because the fleet "
+                    "batch's device counters already agree").inc()
+            else:
+                self.ledger.recount(self.store)
             self.ledger.resync_all_limits(self.store)
 
     async def start(self) -> None:

@@ -372,6 +372,78 @@ def reconcile_step_packed(state: ReconcileState, packed: jax.Array,
     return new_state, jnp.concatenate([hdr, entries, pl_entries])
 
 
+# ---------------------------------------------------------------------------
+# Fleet lane — cross-bucket ragged batching (syncer/core.py FleetBatch).
+#
+# The fleet batch packs every schema bucket's rows into ONE ReconcileState
+# (rows range-partitioned by bucket, slot columns zero-padded to the widest
+# bucket) so a tick is one pipelined device program for the whole tenant
+# fleet. Each row carries a *segment id* — the owning section (engine) —
+# resident on device as an int32 [B] lane beside the state. Two uses:
+#
+# - segment stamps: a row allocated after the last full upload ships its
+#   segment id inside its MASK_STAMP wire entry (flag bits 8..23), the
+#   same entry that carries its status mask — no extra wire entries;
+# - per-segment counters: the step ends with a segment-sum of the new
+#   ``up_exists`` lane, shipped on the wire tail, so admission usage
+#   accounting (admission/quota.py) rides the same batch instead of a
+#   host-side recount pass.
+# ---------------------------------------------------------------------------
+
+SEG_SHIFT = 8  # mask-stamp flag bits [8..23] carry the row's segment id
+SEG_FIELD_MASK = 0xFFFF
+# unowned/freed rows: always >= any real segment capacity, so the
+# counter scatter drops them (capacities stay far below 16 bits)
+SEG_NONE = 0xFFFF
+
+
+def apply_seg_stamps(seg_ids: jax.Array, packed: jax.Array) -> jax.Array:
+    """Scatter segment-id stamps from MASK_STAMP entries into the
+    resident row->segment lane (the fleet analog of apply_mask_stamps:
+    rows allocated after the last full upload are otherwise unknown to
+    the device-side per-segment counters)."""
+    b = seg_ids.shape[0]
+    s = packed.shape[1] - 2
+    flags = packed[:, s + 1]
+    sel = ((flags & 4) != 0) & ((flags & MASK_STAMP_BIT) != 0)
+    idx = packed[:, s].astype(jnp.int32)
+    tgt = jnp.where(sel, idx, b)  # non-stamp entries route OOB -> drop
+    seg = ((flags >> SEG_SHIFT) & SEG_FIELD_MASK).astype(jnp.int32)
+    return seg_ids.at[tgt].set(seg, mode="drop")
+
+
+def reconcile_step_fleet(state: ReconcileState, seg_ids: jax.Array,
+                         packed: jax.Array, acks: jax.Array | None = None,
+                         patch_capacity: int = 8192, seg_capacity: int = 8,
+                         use_pallas: bool = False, mesh=None,
+                         ) -> tuple[ReconcileState, jax.Array, jax.Array]:
+    """The fleet-batch step: :func:`reconcile_step_packed` plus the
+    resident segment lane and per-segment live-row counters.
+
+    ``seg_ids`` (int32 [B], device-resident like the state) maps each
+    fleet row to its owning section's segment id (SEG_NONE = unowned).
+    The wire grows a tail of ``seg_capacity`` int32 counts — the number
+    of live upstream rows per segment after this tick's scatter — which
+    the host routes to the admission quota ledger. Out-of-range segment
+    ids (padding, unowned rows) drop out of the scatter-add.
+    """
+    seg_ids = apply_seg_stamps(seg_ids, packed)
+    new_state, wire = reconcile_step_packed(
+        state, packed, acks, patch_capacity, use_pallas=use_pallas, mesh=mesh)
+    counts = jnp.zeros(seg_capacity, jnp.int32).at[seg_ids].add(
+        new_state.up_exists.astype(jnp.int32), mode="drop")
+    return new_state, seg_ids, jnp.concatenate([wire, counts])
+
+
+def unpack_seg_counts(wire: np.ndarray, patch_capacity: int, r: int, p: int,
+                      seg_capacity: int) -> np.ndarray:
+    """Host-side: the per-segment live-row counts from a fleet wire (the
+    caller knows the submitted patch capacity, placement shape and
+    segment capacity — FleetBatch snapshots them per submit)."""
+    off = PACK_HDR + patch_capacity + r * (1 + p)
+    return wire[off:off + seg_capacity]
+
+
 class WireBuffers:
     """Double-buffered host staging for the packed-delta wire.
 
@@ -443,13 +515,18 @@ def unpack_patches(wire: np.ndarray) -> tuple[np.ndarray, np.ndarray, np.ndarray
     )
 
 
-def unpack_placement(wire: np.ndarray, patch_capacity: int,
-                     p: int) -> tuple[np.ndarray, np.ndarray]:
+def unpack_placement(wire: np.ndarray, patch_capacity: int, p: int,
+                     r: int | None = None) -> tuple[np.ndarray, np.ndarray]:
     """Host-side: (dirty root row indices [N], leaf counts [N, P]) from
     the wire's placement segment (the caller knows the bucket's static
-    patch_capacity and cluster width P)."""
+    patch_capacity and cluster width P). ``r`` bounds the segment to
+    ``r`` placement rows — required for fleet wires, whose tail carries
+    the per-segment counters after the placement entries."""
     n = int(wire[PACK_PLACEMENT_COUNT])
-    seg = wire[PACK_HDR + patch_capacity:].reshape(-1, 1 + p)
+    seg = wire[PACK_HDR + patch_capacity:]
+    if r is not None:
+        seg = seg[:r * (1 + p)]
+    seg = seg.reshape(-1, 1 + p)
     return seg[:n, 0], seg[:n, 1:]
 
 

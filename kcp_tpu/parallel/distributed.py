@@ -73,10 +73,19 @@ def pod_serving_mesh(slots: int = 1) -> Mesh:
     """The canonical serving mesh over the LIVE topology: hosts-major
     when multi-process (DCN boundaries = process boundaries, so
     jax.devices() ordering groups by process), flat tenants otherwise.
-    This is what ``--mesh auto`` resolves to."""
+    This is what ``--mesh auto`` resolves to — and what the fleet batch
+    (syncer/core.py FleetBatch) shards the whole-fleet ragged state over
+    via the same parallel/mesh.py shardings as any bucket state."""
     import jax
 
+    n_devs = len(jax.devices())
     n_proc = jax.process_count()
+    per = n_devs // max(n_proc, 1)
+    if slots < 1 or per % slots:
+        raise ValueError(
+            f"slots={slots} does not divide the {per} devices per host "
+            f"({n_devs} devices / {n_proc} processes); pick a slots axis "
+            f"that divides the per-host device count")
     if n_proc > 1:
         return make_multihost_mesh(hosts=n_proc, slots=slots)
     return make_mesh(slots=slots)

@@ -77,17 +77,24 @@ def mesh_from_spec(spec: str, devices: list | None = None) -> Mesh:
     dims = [int(p) for p in parts]
     if any(d < 1 for d in dims) or len(dims) > 3:
         raise ValueError(f"bad mesh spec {spec!r}: want N, NxM or NxMxK")
-    if len(dims) == 1:
-        return make_mesh(n_devices=dims[0], slots=1, devices=devices)
-    if len(dims) == 2:
-        return make_mesh(n_devices=dims[0] * dims[1], tenants=dims[0],
-                         slots=dims[1], devices=devices)
-    h, t, s = dims
+    # validate the axis product against the available device count up
+    # front with an actionable error — a short spec otherwise surfaces
+    # deep inside jax as a device-array reshape failure
     devs = devices if devices is not None else jax.devices()
-    n = h * t * s
+    n = 1
+    for d in dims:
+        n *= d
     if len(devs) < n:
-        raise ValueError(f"mesh spec {spec!r} needs {n} devices, "
-                         f"have {len(devs)}")
+        raise ValueError(
+            f"mesh spec {spec!r} needs {n} devices, have {len(devs)}; "
+            f"shrink the spec or add devices (virtual devices: "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={n})")
+    if len(dims) == 1:
+        return make_mesh(n_devices=dims[0], slots=1, devices=devs)
+    if len(dims) == 2:
+        return make_mesh(n_devices=n, tenants=dims[0],
+                         slots=dims[1], devices=devs)
+    h, t, s = dims
     return make_multihost_mesh(hosts=h, tenants=t, slots=s, devices=devs[:n])
 
 

@@ -257,7 +257,13 @@ class DeploymentSplitter:
         if plan_rows and self.fused and self._pbucket is not None:
             # SERVED path: roots ride the FusedCore's placement lanes —
             # the same fused step that serves the sync sections computes
-            # the split, and dirty rows come back via placement_apply
+            # the split, and dirty rows come back via placement_apply.
+            # Under fleet dispatch (KCP_FLEET_BATCH, the default) the
+            # kick wakes the whole-fleet ragged batch: placement rows
+            # from every bucket concatenate into ONE device program's
+            # placement lanes, and the FleetBatch scatters the dirty
+            # roots back to this bucket's placement_apply on collect —
+            # so ONE kick per drained batch stays the right granularity
             kicked = False
             for key, root, clusters, leafs in plan_rows:
                 if not clusters:

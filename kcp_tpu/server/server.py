@@ -317,6 +317,13 @@ class Server:
 
             self._controllers.append(UsageRecountController(
                 self.client, admission.ledger, self.store))
+            # the fleet batch's device-side per-segment counters feed
+            # this ledger (FusedCore forwards them on every collect), so
+            # admission accounting rides the fused device batch and the
+            # recount loop can skip its host-side walk when they agree
+            from ..syncer.core import FusedCore
+
+            FusedCore.set_process_ledger(admission.ledger)
         for c in self._controllers:
             await c.start()
 

@@ -53,7 +53,7 @@ import asyncio
 import logging
 import os
 import time
-from typing import Callable, Protocol, Sequence
+from typing import Callable, NamedTuple, Protocol, Sequence
 
 import jax
 import numpy as np
@@ -62,11 +62,15 @@ from .. import faults
 from ..models.reconcile_model import (
     MASK_STAMP_BIT,
     PACK_HDR,
+    SEG_NONE,
+    SEG_SHIFT,
     ReconcileState,
     WireBuffers,
+    reconcile_step_fleet,
     reconcile_step_packed,
     unpack_patches,
     unpack_placement,
+    unpack_seg_counts,
 )
 from ..ops.encode import pad_pow2
 from ..reconciler.controller import BatchController
@@ -81,6 +85,21 @@ def _grown(a: np.ndarray, shape, dtype) -> np.ndarray:
     out = np.zeros(shape, dtype)
     out[: a.shape[0], ...] = a
     return out
+
+
+def _resolve_donate() -> bool:
+    """Per-backend state-donation policy (shared by FusedBucket and
+    FleetBatch): donation is the design on accelerators (steady state
+    lives in HBM), but the CPU pjrt client (jaxlib 0.4.36) mishandles it
+    under the pipelined window — see FusedBucket.__init__. KCP_DONATE=0/1
+    overrides the backend default."""
+    env_donate = os.environ.get("KCP_DONATE", "")
+    if env_donate in ("0", "1"):
+        return env_donate == "1"
+    try:
+        return jax.default_backend() != "cpu"
+    except Exception:  # noqa: BLE001 — backend init failure
+        return False
 
 
 def _phase(name: str, dt: float) -> None:
@@ -120,6 +139,39 @@ QUARANTINE_MAX_BACKOFF = 5.0
 BISECT_MAX_PROBES = 64
 
 
+def _group_test_poison(probe: Callable[[Sequence[int]], bool],
+                       groups: Sequence[Sequence[int]],
+                       max_probes: int) -> list[int]:
+    """The shared bisection loop: group-test ``groups`` of suspect rows
+    against a probe oracle (~k*log2(n) probes for k poisons). Seeding
+    with one group per segment makes the fleet bisection segment-scoped:
+    a clean segment is cleared in ONE probe, and poison isolates within
+    its own segment without probing cross-segment mixtures."""
+    bad: list[int] = []
+    stack: list[list[int]] = [list(g) for g in groups if g]
+    probes = 0
+    while stack:
+        rows = stack.pop()
+        if not rows:
+            continue
+        if probes >= max_probes:
+            log.warning("fused-core: bisection probe budget exhausted; "
+                        "quarantining %d unresolved rows wholesale",
+                        len(rows))
+            bad.extend(rows)
+            continue
+        probes += 1
+        if probe(rows):
+            continue
+        if len(rows) == 1:
+            bad.append(rows[0])
+        else:
+            mid = len(rows) // 2
+            stack.append(rows[:mid])
+            stack.append(rows[mid:])
+    return bad
+
+
 class SectionOwner(Protocol):
     """What an engine provides to its section (see BatchSyncEngine)."""
 
@@ -154,6 +206,10 @@ class Section:
         # seed the mask cache now: row_for stamps every new row with the
         # current mask, so refresh_mask must only fire on real changes
         self._mask: np.ndarray = owner.fused_status_mask().copy()
+        # fleet segment id (FusedCore.register assigns it): the per-row
+        # identity the ragged fleet batch carries on device so the
+        # per-segment counters can attribute live rows to this section
+        self.seg: int | None = None
         self.released = False
 
     def row_for(self, key) -> int:
@@ -172,7 +228,11 @@ class Section:
             # bucket needs no stamp: the pending full upload carries the
             # host mask arrays wholesale (and bulk row preallocation
             # before the first tick would otherwise stage one per row)
-            if self._mask.any() and not self.bucket._stale:
+            # in fleet mode EVERY new row stamps (even an all-False mask):
+            # the stamp entry is also how the device learns the row's
+            # segment id for the per-segment counters
+            if ((self._mask.any() or self.bucket.always_stamp)
+                    and not self.bucket._stale):
                 self.bucket.stage_mask(row, self.bucket.status_mask[row])
         return row
 
@@ -199,7 +259,8 @@ class Section:
 class FusedBucket:
     """One schema bucket: host staging + device-resident fused state."""
 
-    def __init__(self, slots: int, mesh=None, use_pallas: bool = False):
+    def __init__(self, slots: int, mesh=None, use_pallas: bool = False,
+                 always_stamp: bool = False):
         self.S = slots
         self.B = 0
         self.mesh = mesh
@@ -207,6 +268,9 @@ class FusedBucket:
         # on a mesh it runs per device via shard_map (reconcile_model
         # gates on local-row divisibility and falls back to XLA lanes)
         self.use_pallas = use_pallas
+        # fleet mode: every newly-allocated row stages a mask stamp (the
+        # wire entry that also carries its segment id), mask or no mask
+        self.always_stamp = always_stamp
         # converged-row ack compression kill switch, resolved once (the
         # opt-out cannot change mid-process; staging is the hot path)
         self.use_acks = os.environ.get("KCP_NO_ACKS") != "1"
@@ -290,14 +354,7 @@ class FusedBucket:
         # through them). On CPU donation only saves allocator churn (no
         # HBM, outputs are written wholesale either way), so correctness
         # wins. KCP_DONATE=0/1 overrides the backend default.
-        env_donate = os.environ.get("KCP_DONATE", "")
-        if env_donate in ("0", "1"):
-            self.donate = env_donate == "1"
-        else:
-            try:
-                self.donate = jax.default_backend() != "cpu"
-            except Exception:  # noqa: BLE001 — backend init failure
-                self.donate = False
+        self.donate = _resolve_donate()
         self._step = jax.jit(
             reconcile_step_packed,
             donate_argnums=(0,) if self.donate else (),
@@ -736,29 +793,12 @@ class FusedBucket:
         requeue brings them back)."""
         if not self.probe_rows([]):
             return None
-        bad: list[int] = []
-        stack: list[list[int]] = [[int(r) for r in suspects]]
-        probes = 0
-        while stack:
-            rows = stack.pop()
-            if not rows:
-                continue
-            if probes >= max_probes:
-                log.warning("fused-core: bisection probe budget exhausted; "
-                            "quarantining %d unresolved rows wholesale",
-                            len(rows))
-                bad.extend(rows)
-                continue
-            probes += 1
-            if self.probe_rows(rows):
-                continue
-            if len(rows) == 1:
-                bad.append(rows[0])
-            else:
-                mid = len(rows) // 2
-                stack.append(rows[:mid])
-                stack.append(rows[mid:])
-        return bad
+        return _group_test_poison(
+            self.probe_rows, [[int(r) for r in suspects]], max_probes)
+
+    def note_step_failure(self) -> None:
+        self.stats["step_failures"] += 1
+        self._step_failures += 1
 
     def quarantine_row(self, row: int) -> tuple[object | None, Section | None]:
         """Evict one poisoned row: zero its host mirrors (the pending
@@ -795,6 +835,20 @@ class FusedBucket:
         Returns True if the patch set overflowed (caller re-ticks after
         doubling capacity)."""
         idx, code, upsync, overflow, _stats = unpack_patches(wire)
+        self.route_patches(idx, code, upsync)
+        if self.placement_owner is not None:
+            k, p = meta
+            rows, counts = unpack_placement(wire, k, p)
+            self.route_placement(rows, counts)
+        if overflow:
+            self.note_overflow()
+        return bool(overflow)
+
+    def route_patches(self, idx: np.ndarray, code: np.ndarray,
+                      upsync: np.ndarray) -> None:
+        """Route patch rows (bucket-local indices) to their owning
+        sections — shared by the per-bucket dispatch and the fleet batch
+        (which splits a fleet wire's patches by row range first)."""
         per_section: dict[Section, list[tuple[object, int, bool]]] = {}
         dropped = 0
         for r, c, u in zip(idx.tolist(), code.tolist(), upsync.tolist()):
@@ -820,36 +874,536 @@ class FusedBucket:
                 "owner/key (released, freed, or quarantined)").inc(dropped)
         for s, patches in per_section.items():
             s.owner.fused_apply(patches)
-        if self.placement_owner is not None:
-            k, p = meta
-            rows, counts = unpack_placement(wire, k, p)
-            applies = []
-            for i, row in enumerate(rows.tolist()):
-                key = self.pl_row_keys.get(row)
-                if key is not None:
-                    # copy: a view would pin the whole wire buffer in the
-                    # applier queue / retry cache
-                    applies.append((key, counts[i].copy()))
-            if applies:
-                self.placement_owner.placement_apply(applies)
+
+    def route_placement(self, rows: np.ndarray, counts: np.ndarray) -> None:
+        """Route dirty placement rows (bucket-local) to the placement
+        owner."""
+        if self.placement_owner is None:
+            return
+        applies = []
+        for i, row in enumerate(rows.tolist()):
+            key = self.pl_row_keys.get(row)
+            if key is not None:
+                # copy: a view would pin the whole wire buffer in the
+                # applier queue / retry cache
+                applies.append((key, counts[i].copy()))
+        if applies:
+            self.placement_owner.placement_apply(applies)
+
+    def note_overflow(self) -> None:
+        self.stats["overflows"] += 1
+        self.patch_capacity = min(self.patch_capacity * 2, max(self.B, MIN_ROWS))
+
+
+class FleetMeta(NamedTuple):
+    """Per-submit layout snapshot riding with an in-flight fleet wire.
+
+    The fleet layout can change while a wire is still in flight (bucket
+    growth, new buckets, placement widening all mark the fleet stale for
+    the NEXT tick) — collection must unpack against the layout the wire
+    was built under, never the current one."""
+
+    k: int                      # patch capacity submitted
+    p: int                      # placement width in the wire
+    r_total: int                # placement rows in the wire
+    members: tuple              # member buckets, layout order
+    bases: tuple[int, ...]      # fleet row base per member
+    ends: tuple[int, ...]       # fleet row end (base + B) per member
+    pl_members: tuple           # members contributing placement rows
+    pl_bases: tuple[int, ...]
+    pl_ends: tuple[int, ...]
+    seg_capacity: int
+
+
+class FleetBatch:
+    """One ragged device batch for the whole bucket fleet.
+
+    Per-bucket dispatch pays full dispatch/pipeline latency per schema
+    bucket — small and ragged buckets leave the chip idle between kicks.
+    The fleet batch packs EVERY bucket's rows into one unified
+    ReconcileState (rows range-partitioned by bucket, slot columns
+    zero-padded to the widest member, per-row status masks — the [B, S]
+    form the kernels already take) so a reconcile tick is ONE pipelined
+    ``reconcile_step_fleet`` no matter how many buckets exist, and the
+    mesh shardings in parallel/mesh.py spread that single batch over all
+    devices. Results scatter back to per-bucket patch streams on collect
+    (row ranges -> bucket.route_patches), so engines observe byte-
+    identical patch streams vs per-bucket dispatch — the differential-
+    fuzz contract.
+
+    Per-row *segment ids* (the owning section) ride the batch as a
+    resident int32 lane; the step returns per-segment live-row counts on
+    the wire tail, which the core forwards to the admission quota ledger
+    (admission accounting rides the same batch, no host-side pass).
+
+    Degraded mode preserves the PR 2 semantics: a failed step retries
+    once wholesale, then bisects *by segment* — the group test is seeded
+    with one group per member bucket, so poison isolates within its own
+    segment and only the poison rows are quarantined (via the owning
+    bucket, which requeues the keys with bounded backoff).
+    """
+
+    def __init__(self, core: "FusedCore"):
+        self.core = core
+        self.mesh = core.mesh
+        self.use_pallas = core.use_pallas
+        self._members: list[FusedBucket] = []
+        self._bases: list[int] = []
+        self._ends: list[int] = []
+        self._pl_members: list[FusedBucket] = []
+        self._pl_bases: list[int] = []
+        self._pl_ends: list[int] = []
+        self._layout_key: tuple | None = None
+        self.B = 0
+        self.S = 0
+        self.R = 0
+        self.P = 8
+        self._state: ReconcileState | None = None
+        self._seg_ids = None  # device int32 [B]: row -> section segment
+        self._seg_capacity = 8
+        self._stale = True
+        self.ack_capacity = 1024
+        self._wire_bufs = WireBuffers(PIPELINE_DEPTH)
+        self.donate = _resolve_donate()
+        self._step = jax.jit(
+            reconcile_step_fleet,
+            donate_argnums=(0, 1) if self.donate else (),
+            static_argnames=("patch_capacity", "seg_capacity",
+                             "use_pallas", "mesh"),
+        )
+        self._probe_step = None
+        self._last_rows: list[int] = []
+        self._step_failures = 0
+        self.stats = {"ticks": 0, "full_uploads": 0, "overflows": 0,
+                      "acked": 0, "step_failures": 0, "quarantined": 0}
+
+    # ----------------------------------------------------------- layout
+
+    def _refresh_layout(self) -> None:
+        members = list(self.core.buckets.values())
+        key = tuple((id(b), b.B, b.S, b.R, b.P) for b in members)
+        if key == self._layout_key:
+            return
+        self._layout_key = key
+        self._members = members
+        self._bases, self._ends = [], []
+        base, s = 0, 0
+        for b in members:
+            self._bases.append(base)
+            base += b.B
+            self._ends.append(base)
+            s = max(s, b.S)
+        self.B = base
+        self.S = s
+        self._pl_members, self._pl_bases, self._pl_ends = [], [], []
+        r, p = 0, 8
+        for b in members:
+            if b.R:
+                self._pl_members.append(b)
+                self._pl_bases.append(r)
+                r += b.R
+                self._pl_ends.append(r)
+                p = max(p, b.P)
+        self.R = r
+        self.P = p
+        # any layout change invalidates the resident fleet state: row
+        # bases moved, so a full re-upload rebuilds it (bucket growth is
+        # pow2 + rare, same cost class as a bucket's own growth)
+        self._stale = True
+
+    @property
+    def dirty(self) -> bool:
+        return self._stale or any(b.dirty
+                                  for b in self.core.buckets.values())
+
+    def mark_stale(self) -> None:
+        self._stale = True
+
+    def _locate(self, fleet_row: int) -> tuple[FusedBucket, int]:
+        """(owning bucket, bucket-local row) for a fleet row index."""
+        for b, base, end in zip(self._members, self._bases, self._ends):
+            if base <= fleet_row < end:
+                return b, fleet_row - base
+        raise KeyError(f"fleet row {fleet_row} outside layout (B={self.B})")
+
+    # ------------------------------------------------------------ state
+
+    def _placement_leaves(self) -> tuple[np.ndarray, np.ndarray, int, int]:
+        f = self._members[0]._row_factor if self._members else 1
+        if self.R:
+            r, p = self.R, self.P
+            replicas = np.zeros(r, np.int32)
+            avail = np.zeros((r, p), bool)
+            for b, pb in zip(self._pl_members, self._pl_bases):
+                replicas[pb:pb + b.R] = b.pl_replicas
+                avail[pb:pb + b.R, :b.P] = b.pl_avail
+        else:
+            r = ((8 + f - 1) // f) * f
+            p = 8
+            replicas = np.zeros(r, np.int32)
+            avail = np.zeros((r, p), bool)
+        return replicas, avail, r, p
+
+    def _device_state(self) -> tuple[ReconcileState, jax.Array]:
+        """The concatenated fleet state + the row->segment lane, sharded
+        like any bucket state (rows over tenants/hosts, slots over the
+        slots axis; the seg lane shards like the exists flags)."""
+        s = self.S
+        up_vals = np.zeros((self.B, s), np.uint32)
+        down_vals = np.zeros((self.B, s), np.uint32)
+        up_exists = np.zeros(self.B, bool)
+        down_exists = np.zeros(self.B, bool)
+        status_mask = np.zeros((self.B, s), bool)
+        seg = np.full(self.B, SEG_NONE, np.int32)
+        for b, base in zip(self._members, self._bases):
+            end = base + b.B
+            up_vals[base:end, :b.S] = b.up_vals
+            down_vals[base:end, :b.S] = b.down_vals
+            up_exists[base:end] = b.up_exists
+            down_exists[base:end] = b.down_exists
+            status_mask[base:end, :b.S] = b.status_mask
+            for row, sec in b.row_owner.items():
+                if sec.seg is not None:
+                    seg[base + row] = sec.seg
+        replicas, avail, r, p = self._placement_leaves()
+        state = ReconcileState(
+            up_vals=up_vals, up_exists=up_exists,
+            down_vals=down_vals, down_exists=down_exists,
+            status_mask=status_mask,
+            replicas=replicas, avail=avail,
+            current=np.zeros((r, p), np.int32),
+            pair_hashes=np.zeros((self.B, 1), np.uint32),
+            sel_hashes=np.zeros(8, np.uint32),
+        )
+        if self.mesh is not None:
+            from ..parallel.mesh import shard_state, state_shardings
+
+            return (shard_state(state, self.mesh),
+                    jax.device_put(seg, state_shardings(self.mesh)["flags"]))
+        return jax.tree.map(jax.device_put, state), jax.device_put(seg)
+
+    # ------------------------------------------------------------- tick
+
+    def _patch_capacity(self) -> int:
+        # member patch capacities pool into the fleet wire, so one
+        # bucket's overflow-doubled budget benefits the whole batch
+        return min(sum(b.patch_capacity for b in self._members), self.B)
+
+    def submit(self) -> tuple[jax.Array, FleetMeta] | None:
+        """Pack every dirty bucket's staged rows into one ragged batch,
+        run ONE fused step, return the wire (copy_to_host_async issued)
+        plus the layout snapshot needed to unpack it at collect time."""
+        if not self.dirty:
+            return None
+        self._refresh_layout()
+        if not self._members:
+            return None
+        t0 = time.perf_counter()
+        s = self.S
+        self._seg_capacity = pad_pow2(max(self.core._next_seg, 1), floor=8)
+        was_stale = self._stale or any(b._stale for b in self._members)
+        local_rows: list[int] = []  # bucket-local ids for KCP_FAULTS
+        if was_stale:
+            self._state, self._seg_ids = self._device_state()
+            self._stale = False
+            self._last_rows = []
+            for b, base in zip(self._members, self._bases):
+                b._stale = False
+                b._clear_staged()
+                b._pl_staged = False
+                b.stats["full_uploads"] += 1
+                owned = sorted(b.row_owner)
+                local_rows.extend(owned)
+                self._last_rows.extend(base + r for r in owned)
+            self.stats["full_uploads"] += 1
+            buf_slot, packed, acks = self._wire_bufs.acquire(
+                MIN_EVENTS, s + 2, self.ack_capacity)
+        else:
+            if any(b._pl_staged for b in self._members):
+                for b in self._members:
+                    b._pl_staged = False
+                replicas, avail, _r, _p = self._placement_leaves()
+                if self.mesh is not None:
+                    from ..parallel.mesh import state_shardings
+
+                    sh = state_shardings(self.mesh)
+                    reps = jax.device_put(replicas, sh["placement_rows"])
+                    av = jax.device_put(avail, sh["placement"])
+                else:
+                    reps = jax.device_put(replicas)
+                    av = jax.device_put(avail)
+                self._state = self._state._replace(replicas=reps, avail=av)
+            # gather the members' staged arrays (already the packed-wire
+            # layout) into one fleet wire: row indices shift by the
+            # member's base, ack-eligible slots pool on one acks lane,
+            # mask stamps gain the owning section's segment id
+            per: list[tuple] = []
+            nf_total = na_total = nm_total = 0
+            for b, base in zip(self._members, self._bases):
+                n = b._staged_n
+                ack_sel = b._staged_ack[:n]
+                na = int(ack_sel.sum())
+                nm = len(b._staged_masks)
+                per.append((b, base, n, ack_sel, na, nm))
+                nf_total += n - na
+                na_total += na
+                nm_total += nm
+            d = pad_pow2(nf_total + nm_total, floor=MIN_EVENTS)
+            # fleet acks capacity honors each member's sticky high-water
+            # (bench pre-warms bucket.ack_capacity to dodge mid-serving
+            # recompiles — the fleet must not undo that)
+            cap = max(self.ack_capacity,
+                      max((b.ack_capacity for b in self._members),
+                          default=1024))
+            while cap < na_total:
+                cap *= 2
+            self.ack_capacity = cap
+            buf_slot, packed, acks = self._wire_bufs.acquire(d, s + 2, cap)
+            pos = apos = 0
+            self._last_rows = []
+            for b, base, n, ack_sel, na, nm in per:
+                w = b.S
+                if n:
+                    if na:
+                        full_sel = ~ack_sel
+                        nf = n - na
+                        packed[pos:pos + nf, :w] = b._staged_vals[:n][full_sel]
+                        packed[pos:pos + nf, s] = (
+                            b._staged_rows[:n][full_sel] + np.uint32(base))
+                        packed[pos:pos + nf, s + 1] = (
+                            b._staged_flags[:n][full_sel])
+                        acks[apos:apos + na] = (
+                            b._staged_rows[:n][ack_sel].astype(np.int32)
+                            + base)
+                        apos += na
+                        b.stats["acked"] += na
+                        self.stats["acked"] += na
+                        pos += nf
+                    else:
+                        packed[pos:pos + n, :w] = b._staged_vals[:n]
+                        packed[pos:pos + n, s] = (
+                            b._staged_rows[:n] + np.uint32(base))
+                        packed[pos:pos + n, s + 1] = b._staged_flags[:n]
+                        pos += n
+                if nm:
+                    mrows = np.fromiter(b._staged_masks, np.uint32, nm)
+                    masks = np.stack(list(b._staged_masks.values()))
+                    packed[pos:pos + nm, :masks.shape[1]] = (
+                        masks.astype(np.uint32))
+                    packed[pos:pos + nm, s] = mrows + np.uint32(base)
+                    segs = np.fromiter(
+                        (sec.seg if (sec := b.row_owner.get(r)) is not None
+                         and sec.seg is not None else SEG_NONE
+                         for r in b._staged_masks), np.uint32, nm)
+                    packed[pos:pos + nm, s + 1] = (
+                        4 | MASK_STAMP_BIT | (segs << SEG_SHIFT))
+                    pos += nm
+                touched = set(b._staged_rows[:n].tolist())
+                touched.update(b._staged_masks)
+                local_rows.extend(touched)
+                self._last_rows.extend(base + r for r in sorted(touched))
+                b._clear_staged()
+        t1 = time.perf_counter()
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            repl = NamedSharding(self.mesh, PartitionSpec())
+            packed_d = jax.device_put(packed, repl)
+            acks_d = jax.device_put(acks, repl)
+        else:
+            packed_d = jax.device_put(packed)
+            acks_d = jax.device_put(acks)
+        self._wire_bufs.commit(buf_slot, packed_d, acks_d)
+        t2 = time.perf_counter()
+        _phase("put", t2 - t1)
+        k = self._patch_capacity()
+        # KCP_FAULTS `device.step`: rows are BUCKET-LOCAL ids (the union
+        # across members), so a poison_row spec targets the same logical
+        # rows whether dispatch is per-bucket or fleet-wide — the
+        # differential fuzz relies on it
+        faults.maybe_fail("device.step", rows=local_rows)
+        self._state, self._seg_ids, wire = self._step(
+            self._state, self._seg_ids, packed_d, acks_d,
+            patch_capacity=k, seg_capacity=self._seg_capacity,
+            use_pallas=self.use_pallas, mesh=self.mesh,
+        )
+        self._step_failures = 0
+        wire.copy_to_host_async()
+        t3 = time.perf_counter()
+        _phase("full_upload" if was_stale else "pack", t1 - t0)
+        _phase("step_dispatch", t3 - t2)
+        self.stats["ticks"] += 1
+        # member tick counters advance too: the fleet step covers every
+        # bucket's rows, and engines/benches read their bucket's counter
+        for b in self._members:
+            b.stats["ticks"] += 1
+        REGISTRY.counter(
+            "fused_fleet_ticks_total",
+            "fleet-wide ragged batch steps dispatched").inc()
+        REGISTRY.gauge(
+            "fused_fleet_rows", "rows in the fleet batch").set(self.B)
+        REGISTRY.gauge(
+            "fused_fleet_buckets",
+            "schema buckets packed into the fleet batch").set(
+            len(self._members))
+        REGISTRY.gauge(
+            "fused_fleet_segments",
+            "registered sections (fleet segments)").set(
+            len(self.core._segments))
+        meta = FleetMeta(
+            k=k, p=int(self._state.avail.shape[1]),
+            r_total=int(self._state.replicas.shape[0]),
+            members=tuple(self._members), bases=tuple(self._bases),
+            ends=tuple(self._ends), pl_members=tuple(self._pl_members),
+            pl_bases=tuple(self._pl_bases), pl_ends=tuple(self._pl_ends),
+            seg_capacity=self._seg_capacity,
+        )
+        return wire, meta
+
+    # ---------------------------------------------------------- routing
+
+    def dispatch(self, wire: np.ndarray, meta: FleetMeta) -> bool:
+        """Scatter a collected fleet wire back to per-bucket patch
+        streams: split patches and placement rows by the row ranges of
+        the submitting layout, then route through each member's own
+        section/placement routing. Returns True on patch overflow."""
+        idx, code, upsync, overflow, _stats = unpack_patches(wire)
+        if idx.size:
+            ends = np.asarray(meta.ends, np.int64)
+            mi = np.searchsorted(ends, idx, side="right")
+            for j, b in enumerate(meta.members):
+                sel = mi == j
+                if sel.any():
+                    b.route_patches(idx[sel] - meta.bases[j],
+                                    code[sel], upsync[sel])
+        if meta.pl_members:
+            rows, counts = unpack_placement(wire, meta.k, meta.p,
+                                            r=meta.r_total)
+            if rows.size:
+                pl_ends = np.asarray(meta.pl_ends, np.int64)
+                pmi = np.searchsorted(pl_ends, rows, side="right")
+                for j, b in enumerate(meta.pl_members):
+                    sel = pmi == j
+                    if sel.any():
+                        pw = min(b.P, meta.p)
+                        b.route_placement(rows[sel] - meta.pl_bases[j],
+                                          counts[sel][:, :pw])
+        # per-segment live-row counts -> the admission quota ledger
+        self.core._publish_fleet_counts(
+            unpack_seg_counts(wire, meta.k, meta.r_total, meta.p,
+                              meta.seg_capacity))
         if overflow:
             self.stats["overflows"] += 1
-            self.patch_capacity = min(self.patch_capacity * 2, max(self.B, MIN_ROWS))
+            for b in meta.members:
+                b.note_overflow()
         return bool(overflow)
+
+    # ------------------------------------------------------- quarantine
+
+    def note_step_failure(self) -> None:
+        self.stats["step_failures"] += 1
+        self._step_failures += 1
+        for b in self._members:
+            b.stats["step_failures"] += 1
+
+    def probe_rows(self, rows: Sequence[int]) -> bool:
+        """The fleet bisection oracle: one non-donating trial step over a
+        synthetic wire carrying only ``rows`` (fleet ids), rebuilt from
+        the owning buckets' host mirrors. True iff the step completed."""
+        if self.B == 0:
+            return True
+        rows = [int(r) for r in rows]
+        locs = [self._locate(r) for r in rows]
+        try:
+            faults.maybe_fail("device.step", rows=[lr for _b, lr in locs])
+            if self._probe_step is None:
+                self._probe_step = jax.jit(
+                    reconcile_step_fleet,
+                    static_argnames=("patch_capacity", "seg_capacity",
+                                     "use_pallas", "mesh"))
+            if self._state is None:
+                self._state, self._seg_ids = self._device_state()
+                self._stale = False
+            s = self.S
+            d = pad_pow2(max(2 * len(rows), 1), floor=MIN_EVENTS)
+            packed = np.zeros((d, s + 2), np.uint32)
+            for i, ((b, lr), fr) in enumerate(zip(locs, rows)):
+                packed[2 * i, :b.S] = b.up_vals[lr]
+                packed[2 * i, s] = fr
+                packed[2 * i, s + 1] = (1 if b.up_exists[lr] else 0) | 4
+                packed[2 * i + 1, :b.S] = b.down_vals[lr]
+                packed[2 * i + 1, s] = fr
+                packed[2 * i + 1, s + 1] = (
+                    (1 if b.down_exists[lr] else 0) | 2 | 4)
+            acks = np.full(self.ack_capacity, -1, np.int32)
+            if self.mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                repl = NamedSharding(self.mesh, PartitionSpec())
+                packed = jax.device_put(packed, repl)
+                acks = jax.device_put(acks, repl)
+            _state, _seg, wire = self._probe_step(
+                self._state, self._seg_ids, packed, acks,
+                patch_capacity=self._patch_capacity(),
+                seg_capacity=self._seg_capacity,
+                use_pallas=self.use_pallas, mesh=self.mesh)
+            np.asarray(wire)  # force execution; async backends defer errors
+            return True
+        except Exception:  # noqa: BLE001 — any failure means "poisoned"
+            return False
+
+    def bisect_poison(self, suspects: Sequence[int],
+                      max_probes: int = BISECT_MAX_PROBES) -> list[int] | None:
+        """Segment-scoped bisection over the ragged batch: the group test
+        is seeded with one suspect group per member bucket, so a clean
+        segment clears in one probe and poison isolates within its own
+        segment. None when even the empty probe fails (systemic)."""
+        if not self.probe_rows([]):
+            return None
+        groups: dict[int, list[int]] = {}
+        for r in suspects:
+            b, _lr = self._locate(int(r))
+            groups.setdefault(id(b), []).append(int(r))
+        return _group_test_poison(self.probe_rows, list(groups.values()),
+                                  max_probes)
+
+    def quarantine_row(self, row: int) -> tuple[object | None, Section | None]:
+        """Evict one poisoned fleet row via its owning bucket (which
+        zeroes the mirrors, frees the row, marks itself stale — forcing
+        the fleet re-upload — and hands back the key for requeue)."""
+        b, lr = self._locate(int(row))
+        self.stats["quarantined"] += 1
+        return b.quarantine_row(lr)
 
 
 class FusedCore:
     """The per-loop serving core: one tick loop over all fused buckets."""
 
     _instances: dict[int, "FusedCore"] = {}
+    # process-default admission quota ledger (set_process_ledger): the
+    # sink for the fleet batch's device-side per-segment counters
+    _process_ledger = None
 
     def __init__(self, mesh=None, batch_window: float = 0.002,
                  use_pallas: bool | None = None,
-                 pipeline: str | None = None):
+                 pipeline: str | None = None,
+                 fleet: bool | None = None):
         self.mesh = mesh
         if use_pallas is None:
             use_pallas = os.environ.get("KCP_PALLAS", "") == "1"
         self.use_pallas = use_pallas
+        # fleet-wide ragged batching (default on): every tick packs all
+        # dirty buckets into ONE pipelined device program. KCP_FLEET_BATCH=0
+        # is the fallback knob — per-bucket dispatch, the A/B reference
+        # for bench.py --fleet and the ragged differential fuzz
+        if fleet is None:
+            fleet = os.environ.get("KCP_FLEET_BATCH", "1").lower() not in (
+                "0", "false", "off")
+        self.fleet_mode = fleet
+        self._fleet = FleetBatch(self) if fleet else None
+        self._segments: dict[int, Section] = {}  # seg id -> section
+        self._next_seg = 0
+        self.ledger = FusedCore._process_ledger
         # tick pipelining mode: "double" (default) keeps up to
         # PIPELINE_DEPTH steps in flight per bucket — pack N+1 and apply
         # N-1 while the device runs N; "serial" collects every wire in
@@ -921,6 +1475,44 @@ class FusedCore:
                             "pipeline=%s; keeping it", core.pipeline)
         return core
 
+    @classmethod
+    def set_process_ledger(cls, ledger) -> None:
+        """Install the admission quota ledger the fleet batch's device-
+        side per-segment counters feed (server.py wires this when the
+        admission chain has a quota ledger). Applies to live cores too."""
+        cls._process_ledger = ledger
+        for core in cls._instances.values():
+            core.ledger = ledger
+
+    def _publish_fleet_counts(self, seg_counts: np.ndarray) -> None:
+        """Forward a collected fleet wire's per-segment live-row counts
+        to the quota ledger, keyed by each owning section's
+        ``fused_ledger_key()`` (sections without one don't account)."""
+        ledger = self.ledger
+        if ledger is None:
+            return
+        counts: dict[tuple, int] = {}
+        released = []
+        for seg, section in self._segments.items():
+            if section.released:
+                released.append(seg)
+                continue
+            if seg >= seg_counts.shape[0]:
+                continue
+            keyfn = getattr(section.owner, "fused_ledger_key", None)
+            key = keyfn() if keyfn is not None else None
+            if key is None:
+                continue
+            counts[key] = counts.get(key, 0) + int(seg_counts[seg])
+        for seg in released:
+            del self._segments[seg]
+        if counts:
+            ledger.ingest_device_counts(counts)
+            REGISTRY.counter(
+                "fused_fleet_ledger_updates_total",
+                "device-side per-segment count batches forwarded to the "
+                "quota ledger").inc()
+
     def _closed(self) -> bool:
         return self._started and self._refs == 0
 
@@ -968,12 +1560,19 @@ class FusedCore:
     def bucket(self, slots: int) -> FusedBucket:
         b = self.buckets.get(slots)
         if b is None:
-            b = FusedBucket(slots, mesh=self.mesh, use_pallas=self.use_pallas)
+            b = FusedBucket(slots, mesh=self.mesh, use_pallas=self.use_pallas,
+                            always_stamp=self.fleet_mode)
             self.buckets[slots] = b
         return b
 
     def register(self, owner: SectionOwner, slots: int) -> Section:
-        return self.bucket(slots).section(owner)
+        section = self.bucket(slots).section(owner)
+        # fleet segment id: stable for the section's lifetime; retired
+        # ids are not reused (the capacity is pow2-padded and tiny)
+        section.seg = self._next_seg
+        self._segments[self._next_seg] = section
+        self._next_seg += 1
+        return section
 
     def register_placement(self, owner, p: int = 8,
                            slots: int = 64) -> FusedBucket:
@@ -1036,7 +1635,11 @@ class FusedCore:
             "fused_pipeline_depth",
             "in-flight steps per bucket at submit time",
             buckets=DEPTH_BUCKETS)
-        for bucket in self.buckets.values():
+        # fleet mode: ONE ragged batch covers every dirty bucket — the
+        # same pipelined window applies, with the fleet as the unit
+        submitters = ((self._fleet,) if self._fleet is not None
+                      else tuple(self.buckets.values()))
+        for bucket in submitters:
             try:
                 submitted = bucket.submit()
             except Exception as err:  # noqa: BLE001 — degraded-mode gate
@@ -1045,8 +1648,9 @@ class FusedCore:
                 # surface loudly: a row-independent submit failure (bad
                 # sharding, systemic device error) otherwise dies as 5
                 # silent INFO-level retries
-                log.exception("fused-core: bucket submit failed "
-                              "(B=%d S=%d mesh=%s)", bucket.B, bucket.S,
+                log.exception("fused-core: %s submit failed "
+                              "(B=%d S=%d mesh=%s)",
+                              type(bucket).__name__, bucket.B, bucket.S,
                               bucket.mesh is not None)
                 raise
             if submitted is not None:
@@ -1088,15 +1692,17 @@ class FusedCore:
 
     # ------------------------------------------------ degraded-mode path
 
-    def _recover_step_failure(self, bucket: FusedBucket, err: Exception) -> bool:
+    def _recover_step_failure(self, bucket, err: Exception) -> bool:
         """Survive a failed device step without stalling the bucket's
         co-tenants: retry once wholesale (full re-upload rebuilds the
         resident state from the host mirrors — the source of truth), and
         on a second consecutive failure bisect the submitted rows to
-        quarantine the poison. Returns False when the failure is
-        row-independent (the caller then propagates it)."""
-        bucket.stats["step_failures"] += 1
-        bucket._step_failures += 1
+        quarantine the poison. ``bucket`` is a FusedBucket or the
+        FleetBatch (whose bisection is segment-scoped and whose
+        quarantine routes through the owning member bucket). Returns
+        False when the failure is row-independent (the caller then
+        propagates it)."""
+        bucket.note_step_failure()
         REGISTRY.counter(
             "fused_step_failures_total",
             "fused device-step submissions that raised").inc()

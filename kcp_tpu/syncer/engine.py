@@ -250,6 +250,13 @@ class BatchSyncEngine:
     def fused_status_mask(self) -> np.ndarray:
         return self.enc.status_mask()
 
+    def fused_ledger_key(self) -> tuple[str, str]:
+        """(cluster, resource) key for the fleet batch's device-side
+        per-segment counters: the quota ledger's interning key
+        (admission/quota.py ``ingest_device_counts``), so this engine's
+        live synced rows are counted on-device every tick."""
+        return (self._up_cluster(), str(self.gvr))
+
     def _encode_view(self, obj: dict) -> np.ndarray:
         """Encode-once ``enc.encode(_sync_view_ro(obj))``: memoized per
         snapshot identity. The returned row is shared — callers copy it

@@ -11,6 +11,11 @@
 #   churn_{256,768,2048,4096}.json  event-rate headroom curve
 #   rows1m.json            1M-resident-row scale run with the stall
 #                          diagnostics (full_uploads/gap per segment)
+#   fleet.json             ragged fleet-batch A/B (per-bucket vs one
+#                          pipelined program; utilization + throughput)
+# plus, at the repo root:
+#   MULTICHIP_r06.json     ragged fleet step on a virtual 8-device mesh
+#                          (byte-equality vs the single-device run)
 # Each file is ONE bench JSON line; stderr logs sit next to each.
 set -uo pipefail
 cd "$(dirname "$0")/.."
@@ -43,6 +48,38 @@ for c in 256 768 2048 4096; do
     run "churn_$c" KCP_BENCH_CHURN="$c"
 done
 run rows1m KCP_BENCH_ROWS=1048576
+run fleet -- --fleet
+
+# MULTICHIP evidence: the ragged fleet batch on a virtual 8-device
+# (tenants) mesh must emit patch streams byte-identical to the
+# single-device run. Forced onto the host platform so it certifies the
+# sharding math regardless of tunnel health; the JSON lands at the repo
+# root as the round's MULTICHIP artifact.
+echo "== fleet-equivalence (virtual 8-device mesh) ($(date +%H:%M:%S))"
+if env JAX_PLATFORMS=cpu \
+        XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+        python __graft_entry__.py fleet-equivalence 8 \
+        > "$OUT/fleet_equivalence.json" \
+        2> "$OUT/fleet_equivalence.stderr.log"; then
+    python - "$OUT/fleet_equivalence.json" <<'PY'
+import json, sys
+body = json.load(open(sys.argv[1]))
+out = {"n_devices": 8, "rc": 0, "ok": True, "skipped": False,
+       "lane": "fleet-equivalence"}
+out.update(body)
+out["tail"] = (
+    "ragged fleet batch on a virtual 8-device (tenants) mesh: "
+    f"{body['owners']} owners across 2 buckets + straggler, "
+    f"{body['ticks']} ticks, fleet B={body['fleet_rows']}; patch "
+    "streams byte-identical to the single-device run")
+json.dump(out, open("MULTICHIP_r06.json", "w"), indent=2)
+print("MULTICHIP_r06.json:", out["tail"])
+PY
+else
+    echo "FAILED: fleet-equivalence (see $OUT/fleet_equivalence.stderr.log)"
+    FAILURES+=(fleet-equivalence)
+fi
+
 if ((${#FAILURES[@]})); then
     echo "evidence battery INCOMPLETE: ${FAILURES[*]} failed ($OUT)"
     exit 1

@@ -1,0 +1,361 @@
+"""Fleet-wide ragged batching: ragged-vs-per-bucket equivalence.
+
+The fleet batch (syncer/core.py FleetBatch, KCP_FLEET_BATCH=1 default)
+packs every schema bucket's rows into ONE pipelined device program per
+tick. It must be an OBSERVATIONALLY invisible optimization: over an
+identical seeded churn schedule spanning several buckets it must emit
+byte-identical per-owner patch streams vs per-bucket dispatch (the
+differential-fuzz contract every perf PR in this repo ships with), it
+must preserve the PR 2 poison-row semantics (segment-scoped bisection
+quarantining ONLY the poison rows), the PR 1 shutdown-drain ordering,
+and it must feed the admission quota ledger from the device-side
+per-segment counters.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from kcp_tpu import faults
+from kcp_tpu.syncer.core import FusedCore
+
+from helpers import wait_until
+
+
+class Owner:
+    """Open-loop SectionOwner at a chosen slot width: fixed mirrors,
+    every patch recorded, NO feedback — so fleet and per-bucket modes
+    see identical staging schedules and streams compare byte-for-byte
+    (the test_pipeline.py RecordingOwner pattern, width-parameterized)."""
+
+    def __init__(self, core, b: int, s: int):
+        self.core = core
+        self.B, self.S = b, s
+        mask = np.zeros(s, bool)
+        mask[-2:] = True
+        self._mask = mask
+        self.up_vals = np.zeros((b, s), np.uint32)
+        self.down_vals = np.zeros((b, s), np.uint32)
+        self.stream: list[tuple[int, int, bool]] = []
+        self.section = core.register(self, s)
+
+    def fused_status_mask(self) -> np.ndarray:
+        return self._mask
+
+    def fused_encode(self, key: int):
+        return self.up_vals[key], True, self.down_vals[key], True
+
+    def fused_encode_many(self, keys):
+        idx = np.fromiter(keys, np.int64, len(keys))
+        ones = np.ones(idx.size, bool)
+        return self.up_vals[idx], ones, self.down_vals[idx], ones
+
+    def fused_apply(self, patches) -> None:
+        self.stream.extend((int(k), int(c), bool(u)) for k, c, u in patches)
+
+    def fused_overflow(self) -> None:  # pragma: no cover - fixed vocab
+        raise AssertionError("fleet fuzz vocabulary never grows")
+
+
+class LedgerOwner(Owner):
+    """Owner that accounts to the quota ledger (the engine seam)."""
+
+    def __init__(self, core, b, s, ledger_key):
+        self._ledger_key = ledger_key
+        super().__init__(core, b, s)
+
+    def fused_ledger_key(self):
+        return self._ledger_key
+
+
+def _stream_bytes(stream) -> bytes:
+    return np.asarray(
+        [(k, c, int(u)) for k, c, u in stream], np.int64).tobytes()
+
+
+WIDTHS = (16, 32)  # two slot widths -> two schema buckets
+
+
+async def _run_schedule(fleet: bool, seed: int, rows: int = 256,
+                        steps: int = 15, mesh=None,
+                        straggler_rows: int = 3):
+    """Drive one deterministic multi-bucket churn schedule in lockstep
+    (all owners enqueue, then wait for every bucket to tick once) and
+    return per-owner fully-drained patch streams + stats."""
+    core = FusedCore(batch_window=0.0005, pipeline="double", fleet=fleet,
+                     mesh=mesh)
+    owners = [Owner(core, rows, w) for w in WIDTHS]
+    # a 1-4-row straggler section sharing the narrow bucket: the ragged
+    # case the fleet batch exists for
+    straggler = Owner(core, straggler_rows, WIDTHS[0])
+    owners.append(straggler)
+    await core.start()
+    buckets = list({id(o.section.bucket): o.section.bucket for o in owners}
+                   .values())
+    assert len(buckets) == len(WIDTHS), "widths must map to distinct buckets"
+    rng = np.random.default_rng(seed)
+    pool = 100  # < patch capacity so level-triggered re-patches never overflow
+    for step in range(steps):
+        before = {id(b): b.stats["ticks"] for b in buckets}
+        for o in owners:
+            hi = min(pool, o.B)
+            n = int(rng.integers(1, min(16, hi + 1)))
+            touched = rng.choice(hi, size=n, replace=False)
+            o.up_vals[touched] = rng.integers(
+                1, 2**32, (n, o.S), dtype=np.uint32)
+            core.enqueue_many(o.section, False, touched.tolist())
+        assert await wait_until(
+            lambda: all(b.stats["ticks"] > before[id(b)] for b in buckets),
+            10), f"fleet={fleet}: tick never ran for step {step}"
+    await core.stop()
+    assert not core._inflight
+    return ([_stream_bytes(o.stream) for o in owners],
+            [dict(b.stats) for b in buckets],
+            dict(core._fleet.stats) if core._fleet is not None else None)
+
+
+@pytest.mark.parametrize("seed", [2, 11, 29])
+def test_ragged_vs_per_bucket_differential_fuzz(seed):
+    """Byte-identical per-owner patch streams across several buckets
+    (including a 3-row straggler section): fleet packing must not
+    reorder, duplicate, drop, or cross-route decisions."""
+
+    async def main():
+        per_bucket, pb_stats, _ = await _run_schedule(False, seed)
+        ragged, rg_stats, fleet_stats = await _run_schedule(True, seed)
+        for i, (a, b) in enumerate(zip(per_bucket, ragged)):
+            assert a == b, (
+                f"seed={seed}: owner {i} stream diverged "
+                f"({len(a)} vs {len(b)} bytes)")
+        assert any(len(s) > 0 for s in ragged), "no patches — vacuous"
+        # the lockstep drove one staged batch per tick in both modes
+        assert [s["ticks"] for s in pb_stats] == [s["ticks"] for s in rg_stats]
+        # and the whole fleet rode ONE dispatch per tick, not one per bucket
+        assert fleet_stats["ticks"] == rg_stats[0]["ticks"]
+
+    asyncio.run(main())
+
+
+def test_fleet_on_mesh_matches_unsharded_fleet():
+    """The same schedule on an 8-device (virtual) tenants mesh emits the
+    byte-identical streams the single-device fleet emits, and the fleet
+    state actually carries the canonical row sharding."""
+    from kcp_tpu.parallel.mesh import SLOTS_AXIS, TENANTS_AXIS, make_mesh
+
+    async def main():
+        single, _, _ = await _run_schedule(True, seed=5)
+        mesh = make_mesh(n_devices=8, tenants=8, slots=1)
+        core = FusedCore(batch_window=0.0005, pipeline="double", fleet=True,
+                         mesh=mesh)
+        owners = [Owner(core, 256, w) for w in WIDTHS]
+        straggler = Owner(core, 3, WIDTHS[0])
+        owners.append(straggler)
+        await core.start()
+        buckets = list({id(o.section.bucket): o.section.bucket
+                        for o in owners}.values())
+        rng = np.random.default_rng(5)
+        for step in range(15):
+            before = {id(b): b.stats["ticks"] for b in buckets}
+            for o in owners:
+                hi = min(100, o.B)
+                n = int(rng.integers(1, min(16, hi + 1)))
+                touched = rng.choice(hi, size=n, replace=False)
+                o.up_vals[touched] = rng.integers(
+                    1, 2**32, (n, o.S), dtype=np.uint32)
+                core.enqueue_many(o.section, False, touched.tolist())
+            assert await wait_until(
+                lambda: all(b.stats["ticks"] > before[id(b)]
+                            for b in buckets), 15)
+        spec = core._fleet._state.up_vals.sharding.spec
+        assert tuple(spec) == (TENANTS_AXIS, SLOTS_AXIS), spec
+        # fleet rows pad to the row factor: 8-way mesh -> B % 8 == 0
+        assert core._fleet.B % 8 == 0 and core._fleet.B > 0
+        await core.stop()
+        meshed = [_stream_bytes(o.stream) for o in owners]
+        assert meshed == single, "mesh-sharded fleet diverged from single-device"
+
+    asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# poison-row quarantine: segment-scoped bisection
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_poison_quarantine_is_segment_scoped(monkeypatch):
+    """device.step:poison_row=3 poisons bucket-LOCAL row 3 — the same
+    rows a per-bucket schedule would poison. The fleet bisection must
+    isolate within segments and quarantine ONLY those rows: every
+    co-tenant in every bucket still converges."""
+    # keep the wall-clock requeue backoff out of the run
+    monkeypatch.setattr("kcp_tpu.syncer.core.QUARANTINE_BASE_BACKOFF", 0.001)
+
+    async def main():
+        faults.install(faults.FaultInjector("device.step:poison_row=3",
+                                            seed=0))
+        try:
+            core = FusedCore(batch_window=0.0005, pipeline="double",
+                             fleet=True)
+            owners = [Owner(core, 64, w) for w in WIDTHS]
+            await core.start()
+            fleet = core._fleet
+            keys = list(range(30))
+            for o in owners:
+                o.up_vals[keys, 0] = 7  # diverge rows 0..29 in BOTH buckets
+                core.enqueue_many(o.section, False, keys)
+            # the poisoned fleet submission fails, retries once (full
+            # re-upload, fails again), bisects BY SEGMENT, and
+            # quarantines only local row 3 of each poisoned bucket
+            assert await wait_until(
+                lambda: fleet.stats["quarantined"] >= 2, 30), (
+                "fleet never quarantined both buckets' poison rows")
+            for i, o in enumerate(owners):
+                assert await wait_until(
+                    lambda o=o: {k for k, _c, _u in o.stream}
+                    >= set(keys) - {3}, 30), (
+                    f"owner {i} co-tenants stalled")
+                assert 3 not in {k for k, _c, _u in o.stream}
+                assert o.section.bucket.stats["quarantined"] >= 1
+            assert fleet.stats["step_failures"] >= 2
+            # lifting the fault lets the level-triggered loop recover
+            # the quarantined keys (requeued with backoff)
+            faults.clear()
+            for o in owners:
+                assert await wait_until(
+                    lambda o=o: 3 in {k for k, _c, _u in o.stream}, 30), (
+                    "quarantined key never recovered after the fault cleared")
+            await core.stop()
+        finally:
+            faults.clear()
+
+    asyncio.run(main())
+
+
+def test_fleet_systemic_failure_still_propagates():
+    """A row-independent failure (the empty probe fails too) must not be
+    eaten by segment quarantine: after the single wholesale retry it
+    surfaces, and the loop survives."""
+
+    async def main():
+        faults.install(faults.FaultInjector("device.step:raise", seed=0))
+        try:
+            core = FusedCore(batch_window=0.0005, pipeline="serial",
+                             fleet=True)
+            owner = Owner(core, 64, 16)
+            await core.start()
+            owner.up_vals[0, 0] = 1
+            before = core._fleet.stats["step_failures"]
+            core.enqueue(owner.section, False, 0)
+            assert await wait_until(
+                lambda: core._fleet.stats["step_failures"] >= before + 2, 30)
+            assert core._fleet.stats["quarantined"] == 0
+            faults.clear()
+            owner.up_vals[1, 0] = 2
+            core.enqueue(owner.section, False, 1)
+            assert await wait_until(
+                lambda: 1 in {k for k, _c, _u in owner.stream}, 30)
+            await core.stop()
+        finally:
+            faults.clear()
+
+    asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# shutdown drain
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_shutdown_drains_inflight_window():
+    """No tick is lost with fleet wires in flight: churn across several
+    buckets enqueued and never awaited must still deliver every owner's
+    patches through stop()'s shutdown drain (PR 1 ordering: controller
+    final ticks first, THEN the in-flight fleet wires)."""
+
+    async def main():
+        core = FusedCore(batch_window=0.0005, pipeline="double", fleet=True)
+        owners = [Owner(core, 64, w) for w in WIDTHS]
+        await core.start()
+        touched = list(range(40))
+        for o in owners:
+            o.up_vals[touched, 0] = 9
+            core.enqueue_many(o.section, False, touched)
+        await core.stop()
+        assert not core._inflight
+        for i, o in enumerate(owners):
+            patched = {k for k, _c, _u in o.stream}
+            assert patched.issuperset(touched), (
+                f"owner {i} lost {sorted(set(touched) - patched)} in shutdown")
+
+    asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# device-side per-segment counters -> quota ledger
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_segment_counts_feed_quota_ledger():
+    """The fused step's per-segment live-row counts reach the attached
+    quota ledger (admission accounting rides the batch), agree with the
+    ledger's usage when accounting is correct, and flag drift when not."""
+    from kcp_tpu.admission.quota import QuotaLedger
+
+    async def main():
+        ledger = QuotaLedger()
+        core = FusedCore(batch_window=0.0005, fleet=True)
+        core.ledger = ledger
+        o1 = LedgerOwner(core, 64, 16, ("c1", "configmaps"))
+        o2 = LedgerOwner(core, 64, 32, ("c2", "widgets"))
+        await core.start()
+        o1.up_vals[:10, 0] = 1
+        o2.up_vals[:4, 0] = 1
+        core.enqueue_many(o1.section, False, list(range(10)))
+        core.enqueue_many(o2.section, False, list(range(4)))
+        assert await wait_until(
+            lambda: ledger.device_usage_of("c1", "configmaps") == 10
+            and ledger.device_usage_of("c2", "widgets") == 4, 10), (
+            ledger.snapshot())
+        # ledger usage agrees -> the recount fast path may skip the host
+        # walk for limited keys
+        for _ in range(10):
+            ledger.record("configmaps", "c1", +1)
+        for _ in range(4):
+            ledger.record("widgets", "c2", +1)
+        ledger.set_hard("c1", "configmaps", 100)
+        ledger.set_hard("c2", "widgets", 100)
+        # a fresh tick re-reports the counts after the limits landed
+        core.enqueue(o1.section, False, 0)
+        await asyncio.sleep(0.05)
+        assert ledger.device_counts_agree(60.0)
+        # drift (an uncounted write) breaks agreement -> host recount runs
+        ledger.record("configmaps", "c1", +1)
+        assert not ledger.device_counts_agree(60.0)
+        await core.stop()
+
+    asyncio.run(main())
+
+
+def test_fleet_patch_overflow_doubles_member_capacity():
+    """Fleet overflow pools member budgets: overflow doubles every
+    member's patch capacity and the level-triggered retick converges."""
+
+    async def main():
+        core = FusedCore(batch_window=0.0005, fleet=True)
+        owners = [Owner(core, 64, w) for w in WIDTHS]
+        for o in owners:
+            o.section.bucket.patch_capacity = 8  # force overflow
+        await core.start()
+        keys = list(range(40))
+        for o in owners:
+            o.up_vals[keys, 0] = 3
+            core.enqueue_many(o.section, False, keys)
+        for o in owners:
+            assert await wait_until(
+                lambda o=o: {k for k, _c, _u in o.stream} >= set(keys), 30)
+        assert core._fleet.stats["overflows"] >= 1
+        assert all(o.section.bucket.patch_capacity > 8 for o in owners)
+        await core.stop()
+
+    asyncio.run(main())

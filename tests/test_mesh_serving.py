@@ -86,6 +86,10 @@ async def drive_scenario(mesh):
         lambda: up.get("configmaps", "cm-5", "default").get("status") == {"ready": True})
 
     bucket = eng._section.bucket
+    # fleet mode (the serving default) holds the resident state on the
+    # whole-fleet ragged batch; per-bucket fallback holds it per bucket
+    state = (eng.core._fleet._state if eng.core._fleet is not None
+             else bucket._state)
     down_dump = {
         o["metadata"]["name"]: (o["data"], o.get("status"))
         for o in down.list("configmaps")[0]
@@ -95,7 +99,7 @@ async def drive_scenario(mesh):
         for o in up.list("configmaps")[0]
     }
     await syncer.stop()
-    return down_dump, up_status, bucket
+    return down_dump, up_status, bucket, state
 
 
 def test_sharded_serving_end_to_end_matches_single_device():
@@ -109,8 +113,8 @@ def test_sharded_serving_end_to_end_matches_single_device():
     async def single():
         return await drive_scenario(None)
 
-    down_s, up_s, bucket_s = asyncio.run(sharded())
-    down_1, up_1, _ = asyncio.run(single())
+    down_s, up_s, bucket_s, state_s = asyncio.run(sharded())
+    down_1, up_1, _, _ = asyncio.run(single())
 
     assert down_s == down_1
     assert up_s == up_1
@@ -118,10 +122,10 @@ def test_sharded_serving_end_to_end_matches_single_device():
     assert bucket_s.stats["ticks"] >= 2
 
     # the resident device state really is sharded with the canonical spec
-    sh = bucket_s._state.up_vals.sharding
+    sh = state_s.up_vals.sharding
     assert sh.spec == (TENANTS_AXIS, SLOTS_AXIS), sh
-    assert bucket_s._state.status_mask.sharding.spec == (TENANTS_AXIS, SLOTS_AXIS)
-    assert bucket_s._state.up_exists.sharding.spec == (TENANTS_AXIS,)
+    assert state_s.status_mask.sharding.spec == (TENANTS_AXIS, SLOTS_AXIS)
+    assert state_s.up_exists.sharding.spec == (TENANTS_AXIS,)
 
 
 def test_serving_mesh_process_default_reaches_core():
@@ -164,6 +168,47 @@ def test_mesh_from_spec_shapes():
         mesh_from_spec("16")  # only 8 virtual devices available
 
 
+def test_mesh_from_spec_validates_device_count_actionably():
+    """A spec larger than the live device count must fail up front with
+    an error naming the spec, the required and available counts, and the
+    virtual-device escape hatch — never a deep jax reshape failure."""
+    for spec, need in [("16", 16), ("4x4", 16), ("2x4x2", 16)]:
+        with pytest.raises(ValueError) as ei:
+            mesh_from_spec(spec)
+        msg = str(ei.value)
+        assert spec in msg and str(need) in msg and "have 8" in msg
+        assert "xla_force_host_platform_device_count" in msg
+
+
+def test_row_and_slot_factor_on_non_pow2_meshes():
+    """row_factor/slot_factor (the single source of row-axis arithmetic)
+    must be exact on non-power-of-two meshes, and bucket growth must pad
+    row dimensions to the factor so device_put splits cleanly."""
+    from kcp_tpu.parallel.mesh import row_factor, slot_factor
+    from kcp_tpu.syncer.core import FusedBucket
+
+    m3 = mesh_from_spec("3")
+    assert row_factor(m3) == 3 and slot_factor(m3) == 1
+    m32 = make_mesh(n_devices=6, tenants=3, slots=2)
+    assert row_factor(m32) == 3 and slot_factor(m32) == 2
+    m5 = make_mesh(n_devices=5)
+    assert row_factor(m5) == 5 and slot_factor(m5) == 1
+
+    b = FusedBucket(16, mesh=m3)
+    b._grow(65)  # pad_pow2 -> 128, then round up to a multiple of 3
+    assert b.B >= 65 and b.B % row_factor(m3) == 0
+    b._pl_grow(9)
+    assert b.R >= 9 and b.R % row_factor(m3) == 0
+
+
+def test_bucket_slot_axis_divisibility_error():
+    from kcp_tpu.syncer.core import FusedBucket
+
+    m32 = make_mesh(n_devices=6, tenants=3, slots=2)
+    with pytest.raises(ValueError, match="slots axis"):
+        FusedBucket(7, mesh=m32)
+
+
 def test_sharded_overflow_and_growth_paths():
     """Bucket growth (row realloc) and patch overflow doubling must also
     work sharded — the shapes change, the shardings must follow."""
@@ -184,7 +229,9 @@ def test_sharded_overflow_and_growth_paths():
                          timeout=20)
         assert bucket.stats["overflows"] >= 1
         assert bucket.B >= 128
-        assert bucket._state.up_vals.sharding.spec == (TENANTS_AXIS, SLOTS_AXIS)
+        state = (eng.core._fleet._state if eng.core._fleet is not None
+                 else bucket._state)
+        assert state.up_vals.sharding.spec == (TENANTS_AXIS, SLOTS_AXIS)
         await syncer.stop()
 
     asyncio.run(main())
@@ -195,13 +242,13 @@ def test_sharded_serving_on_3d_multihost_mesh():
     also runs on the hosts-major 3D layout a real multi-host pod would
     use (DCN-major axis; parallel/mesh.py)."""
     mesh = mesh_from_spec("2x2x2")
-    down_s, up_s, bucket = asyncio.run(drive_scenario(mesh))
-    down_1, up_1, _ = asyncio.run(drive_scenario(None))
+    down_s, up_s, bucket, state = asyncio.run(drive_scenario(mesh))
+    down_1, up_1, _, _ = asyncio.run(drive_scenario(None))
     assert down_s == down_1
     assert up_s == up_1
     assert bucket.mesh is mesh
     # rows fold over (hosts, tenants): tenant blocks nest in host blocks
-    assert tuple(bucket._state.up_vals.sharding.spec) == (
+    assert tuple(state.up_vals.sharding.spec) == (
         ("hosts", TENANTS_AXIS), SLOTS_AXIS)
 
 
