@@ -1847,7 +1847,9 @@ def sharded_bench() -> int:
                     except RuntimeError:
                         if time.time() > deadline:
                             raise
-                        time.sleep(0.3)
+                        # must yield the loop: the merged-watch reader
+                        # runs on it while we wait out the shard restart
+                        await asyncio.sleep(0.3)
                 # catchup writes land once the breaker's probe re-closes
                 for k in range(10):
                     write(victim_c, f"back-{k}", retry=True)

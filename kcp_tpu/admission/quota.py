@@ -52,6 +52,7 @@ import time
 
 import numpy as np
 
+from ..analysis.sanitize import make_lock
 from ..faults import maybe_fail
 from ..utils.errors import ForbiddenError
 from ..utils.trace import REGISTRY
@@ -114,7 +115,7 @@ class QuotaLedger:
     O(1) — one lock, one interned id, a few scalar array ops."""
 
     def __init__(self, cap: int = 64):
-        self._lock = threading.Lock()
+        self._lock = make_lock("quota.ledger")
         self._idx: dict[tuple[str, str], int] = {}  # (cluster, resource)->i
         self._keys: list[tuple[str, str]] = []
         # usage + hard limits: the vectorized state (recount and gauge

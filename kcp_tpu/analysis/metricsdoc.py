@@ -1,0 +1,165 @@
+"""metrics-doc-drift: code-registered metrics <-> docs/operations.md.
+
+Operators alert on what the runbook documents; a metric registered in
+code but absent from docs/operations.md is invisible telemetry, and a
+documented metric nothing registers is a runbook that lies. This checker
+extracts every ``REGISTRY.counter/gauge/histogram`` registration (literal
+names exactly; f-string names as globs, e.g. ``fused_{name}_seconds`` ->
+``fused_*_seconds``) plus ``span("x")`` sites (which register
+``x_seconds``), and reconciles both directions against the backticked
+tokens of docs/operations.md — ``<name>``/``*`` in doc tokens match glob
+segments, so ``workqueue_depth_<name>`` documents the
+``workqueue_depth_{queue}`` family.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import os
+import re
+
+from .base import Finding, RepoChecker, SourceFile, attr_chain
+
+DOCS_REL = os.path.join("docs", "operations.md")
+
+#: a doc token with one of these suffixes claims to be a metric name
+METRIC_SUFFIXES = ("_total", "_seconds", "_bytes", "_size", "_depth",
+                   "_rows", "_buckets", "_segments")
+
+REGISTRY_METHODS = frozenset({"counter", "gauge", "histogram"})
+
+
+def _name_args(node: ast.expr) -> tuple[list[str], list[str]]:
+    """(literals, globs) a metric-name argument can evaluate to —
+    conditional expressions contribute every literal branch."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value], []
+    if isinstance(node, ast.IfExp):
+        lit_a, glob_a = _name_args(node.body)
+        lit_b, glob_b = _name_args(node.orelse)
+        return lit_a + lit_b, glob_a + glob_b
+    if isinstance(node, ast.JoinedStr):
+        parts: list[str] = []
+        for v in node.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                parts.append(v.value)
+            else:
+                parts.append("*")
+        return [], ["".join(parts)]
+    return [], []
+
+
+def collect_code_metrics(files: list[SourceFile]
+                         ) -> tuple[dict[str, tuple[str, int]],
+                                    dict[str, tuple[str, int]]]:
+    """(literal name -> site, glob -> site) across the file set."""
+    literals: dict[str, tuple[str, int]] = {}
+    globs: dict[str, tuple[str, int]] = {}
+    for f in files:
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            fn = node.func
+            if isinstance(fn, ast.Attribute) and fn.attr in REGISTRY_METHODS:
+                recv = attr_chain(fn.value).lower()
+                if not recv.endswith("registry"):
+                    continue
+                lits, gls = _name_args(node.args[0])
+            elif isinstance(fn, ast.Name) and fn.id == "span":
+                lits, gls = _name_args(node.args[0])
+                lits = [s + "_seconds" for s in lits]
+                gls = [g + "_seconds" for g in gls]
+            else:
+                continue
+            for lit in lits:
+                literals.setdefault(lit, (f.path, node.lineno))
+            for glob in gls:
+                if glob != "*":
+                    globs.setdefault(glob, (f.path, node.lineno))
+    return literals, globs
+
+
+def collect_doc_tokens(docs_path: str) -> dict[str, int]:
+    """Backticked identifier-ish tokens -> first line number."""
+    tokens: dict[str, int] = {}
+    try:
+        with open(docs_path, encoding="utf-8") as fh:
+            lines = fh.readlines()
+    except OSError:
+        return tokens
+    for lineno, line in enumerate(lines, start=1):
+        for span_text in re.findall(r"`([^`]+)`", line):
+            # only whole-span tokens count as metric claims: a token
+            # buried in a path/expression (`ops/foo.max_block_rows`,
+            # `queues × queue_depth`) is prose, not a metric name
+            tok = span_text.strip()
+            if re.fullmatch(r"[a-z][a-z0-9_<>*]+", tok) and "_" in tok:
+                tokens.setdefault(tok, lineno)
+    return tokens
+
+
+def _doc_token_concrete(tok: str) -> str:
+    """A doc token with placeholders, concretized for glob matching:
+    ``workqueue_depth_<name>`` -> ``workqueue_depth_x``."""
+    return re.sub(r"(<[^>]*>|\*)", "x", tok)
+
+
+class MetricsDocChecker(RepoChecker):
+    name = "metrics-doc-drift"
+
+    def check_repo(self, files: list[SourceFile],
+                   repo_root: str) -> list[Finding]:
+        findings: list[Finding] = []
+        literals, globs = collect_code_metrics(files)
+        docs_path = os.path.join(repo_root, DOCS_REL)
+        tokens = collect_doc_tokens(docs_path)
+        if not tokens and not literals:
+            return findings
+        concrete = {t: _doc_token_concrete(t) for t in tokens}
+
+        # code -> docs: every registered metric is documented
+        for name, (path, line) in sorted(literals.items()):
+            if name not in tokens:
+                findings.append(Finding(
+                    self.name, path, line,
+                    f"metric {name!r} is registered here but absent from "
+                    f"{DOCS_REL} — document it (observability table or "
+                    f"runbook)"))
+        for glob, (path, line) in sorted(globs.items()):
+            if not any(fnmatch.fnmatchcase(c, glob)
+                       for c in concrete.values()):
+                findings.append(Finding(
+                    self.name, path, line,
+                    f"dynamic metric family {glob!r} is registered here "
+                    f"but no token in {DOCS_REL} documents it (use a "
+                    f"<name> placeholder form)"))
+
+        # docs -> code: every metric-looking doc token is registered
+        for tok, lineno in sorted(tokens.items()):
+            plain = "<" not in tok and "*" not in tok
+            if plain and not tok.endswith(METRIC_SUFFIXES) \
+                    and tok not in literals:
+                continue  # not claiming to be a metric
+            if plain and tok in literals:
+                continue
+            c = concrete[tok]
+            if any(fnmatch.fnmatchcase(c, g) for g in globs):
+                continue
+            if not plain:
+                # placeholder token: may also summarize several literals
+                pat = fnmatch.translate(_placeholder_glob(tok))
+                if any(re.fullmatch(pat, lit) for lit in literals):
+                    continue
+            if plain and any(fnmatch.fnmatchcase(tok, g) for g in globs):
+                continue
+            findings.append(Finding(
+                self.name, DOCS_REL, lineno,
+                f"docs/operations.md documents metric {tok!r} but nothing "
+                f"in the codebase registers it — stale docs or a renamed "
+                f"metric"))
+        return findings
+
+
+def _placeholder_glob(tok: str) -> str:
+    return re.sub(r"(<[^>]*>|\*)", "*", tok)

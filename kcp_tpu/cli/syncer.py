@@ -105,7 +105,7 @@ async def run(args) -> None:
     if from_server is None:
         if not args.from_kubeconfig:
             raise SystemExit("one of --from-server / -from_kubeconfig required")
-        with open(args.from_kubeconfig, encoding="utf-8") as f:
+        with open(args.from_kubeconfig, encoding="utf-8") as f:  # kcp-lint: disable=async-discipline -- one-shot CLI startup read; nothing is serving on this loop yet
             from_server, token, from_ca = kubeconfig_credentials(f.read())
     upstream = RestClient(from_server, cluster=args.from_cluster, token=token,
                           ca_data=from_ca,

@@ -65,12 +65,37 @@ import random
 import threading
 from dataclasses import dataclass, field
 
+from .analysis.sanitize import make_lock
 from .utils.errors import UnavailableError
 from .utils.trace import REGISTRY
 
 log = logging.getLogger(__name__)
 
 ACTIONS = ("error", "raise", "drop", "latency", "poison_row")
+
+#: The injection-point registry — the single spelling authority for every
+#: point threaded through the codebase. ``scripts/lint.py``'s
+#: fault-point-registry checker cross-references this set against every
+#: ``maybe_fail``/``should_drop`` call site (a typo'd point silently
+#: never fires) and against the ``point:action`` specs in tests (a point
+#: no test exercises is a degraded-mode path with no drill). Add the
+#: point here FIRST when wiring a new site.
+POINTS = frozenset({
+    "store.put",
+    "store.get",
+    "store.list",
+    "store.delete",
+    "watch",
+    "rest.request",
+    "syncer.apply",
+    "device.step",
+    "cluster.health",
+    "admission.chain",
+    "admission.quota",
+    "admission.flow",
+    "encode.cache",
+    "router.proxy",
+})
 
 
 class InjectedFault(RuntimeError):
@@ -134,7 +159,7 @@ class FaultInjector:
     def __init__(self, spec: str = "", seed: int = 0):
         self.spec = spec
         self.seed = seed
-        self._lock = threading.Lock()
+        self._lock = make_lock("faults.injector")
         self._points: dict[str, _PointState] = {}
         for rule in parse_spec(spec):
             st = self._points.setdefault(rule.point, _PointState())

@@ -22,10 +22,12 @@ import subprocess
 import threading
 from typing import Iterator
 
+from ..analysis.sanitize import make_lock
+
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
 _LIB_NAME = "libkcpnative.so"
 
-_lock = threading.Lock()
+_lock = make_lock("native.load")
 _lib: ctypes.CDLL | None = None
 _load_attempted = False
 

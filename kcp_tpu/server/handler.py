@@ -540,8 +540,13 @@ class RestHandler:
         probe); (3) selector lists byte-splice the per-snapshot cached
         bytes. All three are byte-identical to dumping the full dict."""
         from .. import faults as _faults
+        from ..analysis import sanitize as _san
 
-        cacheable = _faults._ACTIVE is None and _faults._ENV_CHECKED
+        # bypassed while faults are active (encode.cache drops must
+        # reach the per-record cache) and under the sanitizer (every hit
+        # must flow through the verifying per-record paths)
+        cacheable = (_faults._ACTIVE is None and _faults._ENV_CHECKED
+                     and not _san.enabled())
         ck = (res, cluster, namespace, req.param("labelSelector") or "", gv)
         if cacheable:
             ent = self._list_cache.get(ck)

@@ -22,6 +22,7 @@ import threading
 import time
 from urllib.parse import quote, urlsplit
 
+from ..analysis.sanitize import make_lock
 from ..apis.scheme import GVR, ResourceInfo, Scheme, default_scheme
 from ..faults import maybe_fail, should_drop
 from ..store.selectors import LabelSelector
@@ -325,7 +326,7 @@ class RestClient:
         # atomic (ADVICE r5). The lock is shared by the clones too;
         # refreshes run under it on the caller's own connection, so
         # holding it never waits on another client's in-flight verb.
-        self._disc_lock = threading.Lock()
+        self._disc_lock = make_lock("rest.discovery")
         # circuit breaker per peer, SHARED by every scoped() clone (like
         # the discovery cache): a dead backend trips once and every
         # cluster-scoped client fails fast instead of each burning its

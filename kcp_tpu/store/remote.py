@@ -33,6 +33,7 @@ import contextlib
 import threading
 from collections import OrderedDict
 
+from ..analysis.sanitize import make_lock
 from .selectors import LabelSelector
 from .store import WILDCARD
 
@@ -142,9 +143,9 @@ class RemoteStore:
         # RestClient guards it with its own _disc_lock (no GIL
         # assumption — see rest.py), so per-entry locks stay strictly
         # about the connection.
-        self._map_lock = threading.Lock()
+        self._map_lock = make_lock("remote.scope_map")
         self._scoped: "OrderedDict[str, tuple[object, threading.Lock]]" = (
-            OrderedDict({WILDCARD: (self._root, threading.Lock())}))
+            OrderedDict({WILDCARD: (self._root, make_lock("remote.scoped_conn"))}))
         self._scoped_cap = 256
         self.base_url = base_url
         # LogicalStore duck-type attributes the handler/client read
@@ -157,7 +158,7 @@ class RemoteStore:
         with self._map_lock:
             e = self._scoped.get(cluster)
             if e is None:
-                e = (self._root.scoped(cluster), threading.Lock())
+                e = (self._root.scoped(cluster), make_lock("remote.scoped_conn"))
                 self._scoped[cluster] = e
                 if len(self._scoped) > self._scoped_cap:
                     key, (evicted, elock) = self._scoped.popitem(last=False)

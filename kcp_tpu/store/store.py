@@ -84,6 +84,7 @@ from typing import Any, Callable, Iterator, Mapping
 
 import numpy as np
 
+from ..analysis import sanitize as _sanitize
 from ..faults import maybe_fail, should_drop
 from ..utils.errors import (
     AlreadyExistsError,
@@ -370,6 +371,11 @@ class LogicalStore:
         from ..utils.raceguard import AffinityGuard
 
         self._race_guard = AffinityGuard("LogicalStore")
+        # runtime sanitizer (KCP_SANITIZE=1): stored snapshots freeze
+        # (mutation raises at the violating line) and the encode caches
+        # verify every hit against a fresh encode — the crash-loudly
+        # twin of the CoW/frozen-bytes lint contracts
+        self._sanitize = _sanitize.enabled()
         # admission quota accounting: called (resource, cluster, +1/-1)
         # whenever the object map gains/loses a key — the mutation-level
         # usage hook the QuotaLedger attaches (admission/quota.py). None
@@ -497,8 +503,13 @@ class LogicalStore:
 
     # ------------------------------------------------------------- index
 
-    def _put_obj(self, key: Key, obj: dict) -> None:
-        """Insert/replace an object in the map and the secondary index."""
+    def _put_obj(self, key: Key, obj: dict) -> dict:
+        """Insert/replace an object in the map and the secondary index.
+        Returns the stored snapshot — under the sanitizer it is a frozen
+        proxy, and callers emit/log THAT object so events keep sharing
+        the stored snapshot's identity."""
+        if self._sanitize:
+            obj = _sanitize.freeze(obj)
         old = self._objects.get(key)
         if self._usage_hook is not None and old is None:
             self._usage_hook(key[0], key[1], 1)
@@ -512,6 +523,7 @@ class LogicalStore:
         self._objects[key] = obj
         r, c, n, _ = key
         self._buckets.setdefault(r, {}).setdefault(c, {}).setdefault(n, {})[key] = obj
+        return obj
 
     def _del_obj(self, key: Key) -> None:
         old = self._objects.get(key)
@@ -586,7 +598,7 @@ class LogicalStore:
         meta["generation"] = 1
         rv = self._next_rv()
         meta["resourceVersion"] = str(rv)
-        self._put_obj(key, obj)
+        obj = self._put_obj(key, obj)
         self._emit(ADDED, key, obj, rv)
         self._log_wal({"op": "put", "key": list(key), "obj": obj, "rv": rv})
         return copy.deepcopy(obj)
@@ -670,7 +682,7 @@ class LogicalStore:
         new_meta["generation"] = ex_meta.get("generation", 1) + (1 if spec_changed else 0)
         rv = self._next_rv()
         new_meta["resourceVersion"] = str(rv)
-        self._put_obj(key, new_obj)
+        new_obj = self._put_obj(key, new_obj)
 
         # finalizer-driven deletion completion
         if new_meta.get("deletionTimestamp") and not new_meta.get("finalizers"):
@@ -699,7 +711,7 @@ class LogicalStore:
                 obj["metadata"]["deletionTimestamp"] = self._now()
                 rv = self._next_rv()
                 obj["metadata"]["resourceVersion"] = str(rv)
-                self._put_obj(key, obj)
+                obj = self._put_obj(key, obj)
                 self._emit(MODIFIED, key, obj, rv, old=existing)
                 self._log_wal({"op": "put", "key": list(key), "obj": obj, "rv": rv})
             return
@@ -837,6 +849,9 @@ class LogicalStore:
             if should_drop("encode.cache"):
                 del self._enc_bytes[id(obj)]
             else:
+                if self._sanitize:
+                    _sanitize.verify_bytes(
+                        ent[1], json.dumps(obj).encode(), "snapshot bytes")
                 self._enc_hits.inc()
                 self._enc_shared.inc(len(ent[1]))
                 return ent[1]
@@ -854,9 +869,11 @@ class LogicalStore:
             return [json.dumps(o).encode() for o in objs]
         from .. import faults as _faults
 
-        if _faults._ACTIVE is not None or not _faults._ENV_CHECKED:
+        if (_faults._ACTIVE is not None or not _faults._ENV_CHECKED
+                or self._sanitize):
             # an active KCP_FAULTS schedule must see one encode.cache
-            # decision per entry, exactly like the per-item path
+            # decision per entry, exactly like the per-item path — and
+            # the sanitizer verifies each hit there
             return [self.encode_obj(o) for o in objs]
         cache = self._enc_bytes
         dumps = json.dumps
@@ -923,7 +940,8 @@ class LogicalStore:
         from .. import faults as _faults
 
         ver = self._bucket_ver.get(bk, 0)
-        if _faults._ACTIVE is None and _faults._ENV_CHECKED:
+        if _faults._ACTIVE is None and _faults._ENV_CHECKED \
+                and not self._sanitize:
             ent = self._span_cache.get(bk)
             if ent is not None and ent[0] == ver:
                 self._enc_hits.inc()
@@ -951,6 +969,13 @@ class LogicalStore:
                 if should_drop("encode.cache"):
                     object.__setattr__(ev, "_enc_line", None)
                 else:
+                    if self._sanitize:
+                        _sanitize.verify_bytes(
+                            line,
+                            json.dumps({"type": ev.type,
+                                        "object": ev.object}).encode()
+                            + b"\n",
+                            "watch event line")
                     self._enc_hits.inc()
                     self._enc_shared.inc(len(line))
                     return line
@@ -978,7 +1003,7 @@ class LogicalStore:
         from .. import faults as _faults
 
         if (not self._encode_cache or _faults._ACTIVE is not None
-                or not _faults._ENV_CHECKED):
+                or not _faults._ENV_CHECKED or self._sanitize):
             return [self.encode_event(ev) for ev in evs]
         out: list[bytes] = []
         hits = shared = 0

@@ -27,6 +27,8 @@ import re
 import threading
 import time
 
+from ..analysis.sanitize import make_lock
+
 from .errors import UnavailableError
 from .trace import REGISTRY
 
@@ -54,7 +56,7 @@ class CircuitBreaker:
         self.jitter = jitter
         self._clock = clock
         self._rng = random.Random(seed if seed is not None else name)
-        self._lock = threading.Lock()
+        self._lock = make_lock("circuit.breaker")
         self._state = CLOSED
         self._failures = 0
         self._backoff = reset_timeout

@@ -26,6 +26,8 @@ import contextlib
 import threading
 import time
 from bisect import bisect_left
+
+from ..analysis.sanitize import make_lock
 from dataclasses import dataclass, field
 
 _DEFAULT_BUCKETS = (
@@ -104,7 +106,7 @@ class Registry:
     """Named metrics with Prometheus text exposition."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("trace.registry")
         self._metrics: dict[str, Counter | Gauge | Histogram] = {}
 
     def counter(self, name: str, help: str = "") -> Counter:

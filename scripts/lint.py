@@ -1,0 +1,24 @@
+#!/usr/bin/env python
+"""kcp-lint CLI — contract-aware static analysis for this repo.
+
+Usage:
+    python scripts/lint.py                      # all checkers, text output
+    python scripts/lint.py --format json        # machine-readable (CI)
+    python scripts/lint.py --rules cow-mutation,frozen-bytes
+    python scripts/lint.py kcp_tpu/store        # lint a subtree only
+
+Exit status: 0 = no active findings (waived ones never fail), 1 = at
+least one finding. Waive a legitimate write-boundary site by appending a
+comment ``kcp-lint: disable=<rule> -- <justification>`` to the flagged
+line; waivers without justification are themselves findings.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kcp_tpu.analysis.runner import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -121,6 +121,93 @@ def test_store_put_injection_and_metric():
     store.create("configmaps", "c", {"metadata": {"name": "x"}})  # healthy
 
 
+def test_store_read_verbs_are_injectable():
+    # store.get:error / store.list:error / store.delete:error — every
+    # store verb must fail like put under an injected 503, so chaos
+    # schedules can exercise read-path and delete-path error handling
+    store = LogicalStore()
+    store.create("configmaps", "c", {"metadata": {"name": "x"}})
+    faults.install(faults.FaultInjector(
+        "store.get:error=1.0;store.list:error=1.0;store.delete:error=1.0",
+        seed=0))
+    with pytest.raises(UnavailableError):
+        store.get("configmaps", "c", "x")
+    with pytest.raises(UnavailableError):
+        store.list("configmaps")
+    with pytest.raises(UnavailableError):
+        store.delete("configmaps", "c", "x")
+    assert counter("fault_injected_store_get_total") >= 1
+    assert counter("fault_injected_store_list_total") >= 1
+    assert counter("fault_injected_store_delete_total") >= 1
+    faults.clear()
+    assert store.get("configmaps", "c", "x")["metadata"]["name"] == "x"
+    assert store.list("configmaps")[0]
+    store.delete("configmaps", "c", "x")  # healthy again
+
+
+def test_admission_flow_fault_injects_503_before_token_accounting():
+    # admission.flow:error — the flow controller's acquire is a fault
+    # point; an injected 503 must surface before any token is spent
+    from kcp_tpu.admission.flow import FlowController
+
+    fc = FlowController(concurrency=4, rate=100.0)
+    faults.install(faults.FaultInjector("admission.flow:error@tick=1", seed=0))
+    with pytest.raises(UnavailableError):
+        fc.try_acquire("tenant-a", "create")
+    # the one-tick schedule is spent: the same flow admits cleanly, with
+    # its full burst intact (the injected failure charged no token)
+    release = fc.try_acquire("tenant-a", "create")
+    assert callable(release)
+    release()
+
+
+def test_cluster_health_fault_reads_as_unhealthy_syncer(monkeypatch):
+    # cluster.health:error — an injected fault at the pull-mode health
+    # probe must flip Ready=False (feeding the splitter's evacuation
+    # machinery), and clearing the schedule must let Ready recover
+    from kcp_tpu.apis.cluster import is_ready, set_synced_resources
+    from kcp_tpu.reconcilers.cluster import ClusterController, SyncerMode
+    from kcp_tpu.reconcilers.cluster import installer as installer_mod
+
+    async def main():
+        store = LogicalStore()
+        mc = MultiClusterClient(store)
+        t = mc.cluster_client("tenant-1")
+        cl = new_cluster("east", kubeconfig="fake://east")
+        set_synced_resources(cl, ["deployments.apps"])
+        t.create(CLUSTERS_GVR, cl)
+
+        class Registry:
+            def resolve(self, kubeconfig):
+                return object()
+
+        ctrl = ClusterController(mc, Registry(), mode=SyncerMode.PULL,
+                                 poll_interval=30.0)
+        key = ("tenant-1", "east")
+
+        class StubImporter:
+            def start(self):
+                pass
+
+            def stop(self):
+                pass
+
+        ctrl.importers[key] = StubImporter()
+        monkeypatch.setattr(installer_mod, "healthcheck_syncer",
+                            lambda physical: (True, ""))
+        faults.install(faults.FaultInjector("cluster.health:error=1.0",
+                                            seed=0))
+        await ctrl._reconcile(key, t.get(CLUSTERS_GVR, "east"))
+        assert not is_ready(t.get(CLUSTERS_GVR, "east")), (
+            "injected health fault did not flip Ready=False")
+        faults.clear()
+        await ctrl._reconcile(key, t.get(CLUSTERS_GVR, "east"))
+        assert is_ready(t.get(CLUSTERS_GVR, "east")), (
+            "Ready did not recover after the schedule cleared")
+
+    asyncio.run(main())
+
+
 def test_watch_drop_recovers_via_informer_relist():
     async def main():
         store = LogicalStore()

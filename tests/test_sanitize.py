@@ -1,0 +1,219 @@
+"""Runtime sanitizer (KCP_SANITIZE=1) drills: seeded deliberate
+violations of each data contract are caught with an actionable error
+naming the contract, and the sanctioned paths stay green — the
+crash-loudly twin of the kcp-lint static checkers.
+
+The full differential fuzzes run under the sanitizer in scripts/ci.sh
+(store-index + encode-cache suites with KCP_SANITIZE=1); this file keeps
+the deliberate-violation drills and a small clean end-to-end.
+"""
+
+import asyncio
+import copy
+
+import pytest
+
+from kcp_tpu.analysis import sanitize
+from kcp_tpu.analysis.sanitize import ContractViolation
+from kcp_tpu.client import Client, Informer
+from kcp_tpu.store import LogicalStore
+
+
+@pytest.fixture(autouse=True)
+def _sanitized():
+    sanitize.enable(True)
+    sanitize.reset_lock_tracking()
+    yield
+    sanitize.enable(False)
+    sanitize.reset_lock_tracking()
+
+
+def _store() -> LogicalStore:
+    s = LogicalStore(indexed=True, encode_cache=True)
+    assert s._sanitize
+    return s
+
+
+def _mk(name: str, labels: dict | None = None) -> dict:
+    return {"kind": "ConfigMap", "apiVersion": "v1",
+            "metadata": {"name": name, "labels": labels or {}},
+            "spec": {"replicas": 1}}
+
+
+# ---------------------------------------------------------------------------
+# CoW snapshot freezing
+# ---------------------------------------------------------------------------
+
+
+def test_mutating_a_listed_snapshot_raises_naming_the_contract():
+    store = _store()
+    store.create("configmaps", "c", _mk("x"))
+    items, _rv = store.list("configmaps")
+    with pytest.raises(ContractViolation) as ei:
+        items[0]["metadata"]["labels"]["touched"] = "yes"
+    assert "cow-mutation" in str(ei.value)
+    assert "re-get()" in str(ei.value)  # the error names the fix
+    # nested containers are frozen too
+    with pytest.raises(ContractViolation):
+        items[0]["spec"].update({"replicas": 2})
+
+
+def test_mutating_a_watch_event_payload_raises():
+    store = _store()
+    w = store.watch("configmaps")
+    store.create("configmaps", "c", _mk("x"))
+    evs = w.drain()
+    assert evs
+    with pytest.raises(ContractViolation):
+        evs[0].object["metadata"]["name"] = "hijacked"
+
+
+def test_sanctioned_edit_path_stays_green():
+    store = _store()
+    store.create("configmaps", "c", _mk("x"))
+    obj = store.get("configmaps", "c", "x")  # private mutable copy
+    obj["metadata"]["labels"] = {"a": "b"}
+    updated = store.update("configmaps", "c", obj)
+    assert updated["metadata"]["labels"] == {"a": "b"}
+    # deepcopy of a cached snapshot thaws to plain containers
+    snap = store.get_snapshot("configmaps", "c", "x")
+    mine = copy.deepcopy(snap)
+    assert type(mine) is dict and type(mine["metadata"]) is dict
+    mine["metadata"]["labels"]["c"] = "d"  # no raise
+
+
+def test_wal_restored_snapshots_are_frozen_too(tmp_path):
+    path = str(tmp_path / "wal.jsonl")
+    s1 = LogicalStore(wal_path=path, wal_backend="json")
+    s1.create("configmaps", "c", _mk("x"))
+    s1.close()
+    s2 = LogicalStore(wal_path=path, wal_backend="json")
+    items, _ = s2.list("configmaps")
+    with pytest.raises(ContractViolation):
+        items[0]["metadata"]["name"] = "evil"
+    s2.close()
+
+
+# ---------------------------------------------------------------------------
+# frozen-bytes verification
+# ---------------------------------------------------------------------------
+
+
+def test_scribbled_event_line_is_caught_on_next_hit():
+    store = _store()
+    w = store.watch("configmaps")
+    store.create("configmaps", "c", _mk("x"))
+    ev = w.drain()[0]
+    line = store.encode_event(ev)  # populate the cached wire line
+    assert line.endswith(b"}\n")
+    object.__setattr__(ev, "_enc_line", b'{"type": "ADDED", "object": {}}\n')
+    with pytest.raises(ContractViolation) as ei:
+        store.encode_event(ev)
+    assert "frozen-bytes" in str(ei.value)
+    assert "watch event line" in str(ei.value)
+
+
+def test_scribbled_record_cache_entry_is_caught():
+    store = _store()
+    store.create("configmaps", "c", _mk("x"))
+    snap = store.get_snapshot("configmaps", "c", "x")
+    store.encode_obj(snap)  # populate
+    store._enc_bytes[id(snap)] = (snap, b'{"forged": true}')
+    with pytest.raises(ContractViolation) as ei:
+        store.encode_obj(snap)
+    assert "frozen-bytes" in str(ei.value)
+
+
+def test_clean_encode_paths_verify_green():
+    store = _store()
+    for i in range(8):
+        store.create("configmaps", "c", _mk(f"x{i}"))
+    items, _ = store.list("configmaps")
+    first = store.encode_many(items)
+    second = store.encode_many(items)  # all hits, all verified
+    assert first == second
+    spans, _rv = store.list_encoded("configmaps")
+    assert b", ".join(spans) == b", ".join(first)
+
+
+# ---------------------------------------------------------------------------
+# lock-order tracking
+# ---------------------------------------------------------------------------
+
+
+def test_inverted_lock_pair_raises_before_deadlocking():
+    a = sanitize.make_lock("drill.a")
+    b = sanitize.make_lock("drill.b")
+    assert isinstance(a, sanitize.TrackedLock)
+    with a:
+        with b:
+            pass
+    # same order again: fine
+    with a:
+        with b:
+            pass
+    # inverted order: must raise at acquire time, naming both locks
+    with pytest.raises(ContractViolation) as ei:
+        with b:
+            with a:
+                pass
+    msg = str(ei.value)
+    assert "lock-order" in msg and "drill.a" in msg and "drill.b" in msg
+    assert "deadlock" in msg
+
+
+def test_lock_graph_records_edges_and_release_unwinds():
+    a = sanitize.make_lock("drill.c")
+    b = sanitize.make_lock("drill.d")
+    with a:
+        pass
+    with b:
+        pass  # disjoint acquisitions: no edges
+    assert "drill.c" not in sanitize.lock_edges()
+    with a:
+        with b:
+            pass
+    assert "drill.d" in sanitize.lock_edges()["drill.c"]
+    # sequential (non-nested) re-acquisition after release is clean
+    with b:
+        pass
+
+
+def test_make_lock_is_plain_lock_when_disabled():
+    sanitize.enable(False)
+    lk = sanitize.make_lock("drill.plain")
+    assert not isinstance(lk, sanitize.TrackedLock)
+    sanitize.enable(True)
+
+
+# ---------------------------------------------------------------------------
+# clean end-to-end under the sanitizer: informer + CRUD churn converges
+# ---------------------------------------------------------------------------
+
+
+def test_informer_loop_runs_clean_under_sanitizer():
+    async def main():
+        store = _store()
+        client = Client(store, "t")
+        inf = Informer(client, "configmaps")
+        await inf.start()
+        for i in range(16):
+            client.create("configmaps", _mk(f"n{i}", {"ring": str(i % 3)}))
+        obj = client.get("configmaps", "n3")
+        obj["spec"] = {"replicas": 7}
+        client.update("configmaps", obj)
+        client.delete("configmaps", "n5")
+        for _ in range(50):
+            await asyncio.sleep(0.01)
+            if (inf.get("t", "n5") is None
+                    and (inf.get("t", "n3") or {}).get("spec", {})
+                    .get("replicas") == 7):
+                break
+        assert inf.get("t", "n5") is None
+        assert inf.get("t", "n3")["spec"]["replicas"] == 7
+        # the cache IS the frozen store snapshot — mutation raises
+        with pytest.raises(ContractViolation):
+            inf.get("t", "n3")["spec"]["replicas"] = 99
+        await inf.stop()
+
+    asyncio.run(main())
