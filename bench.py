@@ -1891,6 +1891,223 @@ def sharded_bench() -> int:
     return 0
 
 
+def replica_bench() -> int:
+    """HA replication A/B (``--replica``): read capacity at 0/1/2 read
+    replicas, replica visibility lag, byte-equality at the same RV, and
+    the kill-the-primary drill. One JSON line; ``value`` is the fleet
+    read-capacity speedup at the largest replica count vs the bare
+    primary.
+
+    Like the sharded lane, capacity is honest on few-core CI hosts:
+    each serving endpoint (primary + each replica) is measured in its
+    own time slice under the same fixed list query, and fleet capacity
+    is the sum — replicas share nothing on the read path (each serves
+    from its own store + encode cache), so the sum is what N hosts
+    would serve. Lag is measured as write-to-replica-visibility: after
+    each primary write, the time until the replica's applied RV covers
+    it (p50/p99 ms). The kill drill runs durable primary+standby,
+    SIGKILL-equivalent death mid-workload, and reports promotion
+    latency (kill -> first successful standby write) and acked-write
+    loss (floor: zero).
+    """
+    import tempfile
+
+    from kcp_tpu.server.rest import MultiClusterRestClient, RestClient
+    from kcp_tpu.server.server import Config
+    from kcp_tpu.server.threaded import ServerThread
+
+    objects = int(os.environ.get("KCP_BENCH_REPL_OBJECTS", "2000"))
+    seconds = float(os.environ.get("KCP_BENCH_REPL_SECONDS", "1.0"))
+    counts = sorted(int(x) for x in os.environ.get(
+        "KCP_BENCH_REPL_COUNTS", "0,1,2").split(",") if x.strip())
+    lag_writes = int(os.environ.get("KCP_BENCH_REPL_LAG_WRITES", "200"))
+    drill_writes = int(os.environ.get("KCP_BENCH_REPL_DRILL_WRITES", "80"))
+    clusters = [f"t{i}" for i in range(8)]
+
+    def cm(name: str, cluster: str, data: str = "") -> dict:
+        return {"apiVersion": "v1", "kind": "ConfigMap",
+                "metadata": {"name": name, "namespace": "default",
+                             "clusterName": cluster}, "data": {"v": data}}
+
+    def status(address: str) -> dict:
+        c = RestClient(address)
+        try:
+            return c._request("GET", "/replication/status")
+        finally:
+            c.close()
+
+    def wait_applied(address: str, rv: int, timeout: float = 60.0) -> None:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if status(address)["applied_rv"] >= rv:
+                return
+            time.sleep(0.02)
+        raise RuntimeError(f"replica {address} never reached rv {rv}")
+
+    def read_rate(address: str, target: str, secs: float) -> float:
+        c = RestClient(address)
+        try:
+            c.request_raw("GET", target)  # warm connection + caches
+            n = 0
+            t0 = time.perf_counter()
+            stop = t0 + secs
+            while time.perf_counter() < stop:
+                s, _h, _b = c.request_raw("GET", target)
+                assert s == 200, s
+                n += 1
+            return n / (time.perf_counter() - t0)
+        finally:
+            c.close()
+
+    primary = ServerThread(Config(durable=False, install_controllers=False,
+                                  tls=False)).start()
+    replicas: list[ServerThread] = []
+    results: dict = {"host_cpus": os.cpu_count(), "objects": objects,
+                     "seconds": seconds}
+    capacities: dict[str, float] = {}
+    bytes_equal = True
+    try:
+        pc = MultiClusterRestClient(primary.address)
+        for i in range(objects):
+            pc.create("configmaps", cm(f"seed{i}", clusters[i % 8], str(i)))
+        seed_rv = status(primary.address)["applied_rv"]
+        target = "/clusters/t0/api/v1/namespaces/default/configmaps"
+        per_slice = max(0.25, seconds / (max(counts) + 1))
+        for n in counts:
+            while len(replicas) < n:
+                replicas.append(ServerThread(Config(
+                    durable=False, install_controllers=False, tls=False,
+                    role="replica", primary=primary.address)).start())
+                wait_applied(replicas[-1].address, seed_rv)
+            endpoints = [primary.address] + [r.address for r in replicas[:n]]
+            capacities[str(n)] = round(sum(
+                read_rate(a, target, per_slice) for a in endpoints), 1)
+        base = capacities.get("0") or 1.0
+        speedup = {k: round(v / base, 2) for k, v in capacities.items()}
+
+        # byte equality at the same RV (encode-once path on both sides)
+        c0 = RestClient(primary.address)
+        _s, _h, pb = c0.request_raw("GET", target)
+        c0.close()
+        for r in replicas:
+            cr = RestClient(r.address)
+            _s, _h, rb = cr.request_raw("GET", target)
+            cr.close()
+            if rb != pb:
+                bytes_equal = False
+
+        # replica visibility lag (1 replica attached is the common case)
+        lags_ms: list[float] = []
+        if replicas:
+            rep = replicas[0]
+            rc = RestClient(rep.address)
+            for i in range(lag_writes):
+                out = pc.create("configmaps", cm(f"lag{i}", "t1", str(i)))
+                rv = int(out["metadata"]["resourceVersion"])
+                t0 = time.perf_counter()
+                while True:
+                    st = rc._request("GET", "/replication/status")
+                    if st["applied_rv"] >= rv:
+                        break
+                    time.sleep(0.0005)
+                lags_ms.append((time.perf_counter() - t0) * 1e3)
+            rc.close()
+        pc.close()
+    finally:
+        for r in replicas:
+            r.stop()
+        primary.stop()
+
+    lag_stats = {}
+    if lags_ms:
+        import numpy as _np
+
+        lag_stats = {"p50_ms": round(float(_np.percentile(lags_ms, 50)), 3),
+                     "p99_ms": round(float(_np.percentile(lags_ms, 99)), 3),
+                     "writes": len(lags_ms)}
+
+    # ---- kill-the-primary drill (durable pair, real WAL on disk) ----
+    drill: dict = {}
+    with tempfile.TemporaryDirectory() as td:
+        p = ServerThread(Config(durable=True, install_controllers=False,
+                                tls=False,
+                                root_dir=os.path.join(td, "p"))).start()
+        s = ServerThread(Config(durable=True, install_controllers=False,
+                                tls=False, role="standby",
+                                primary=p.address, repl_hysteresis_s=0.4,
+                                root_dir=os.path.join(td, "s"))).start()
+        try:
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                if p.call(lambda: p.server.repl_hub.has_sync_subscribers):
+                    break
+                time.sleep(0.05)
+            pc = MultiClusterRestClient(p.address)
+            sc = MultiClusterRestClient(s.address)
+            acked: list[str] = []
+            killed_at = None
+            promoted_at = None
+            kill_at = drill_writes // 2
+            for i in range(drill_writes):
+                name = f"d{i}"
+                if i == kill_at:
+                    killed_at = time.perf_counter()
+                    p.kill()
+                stop = time.time() + 30
+                while True:
+                    client = pc if killed_at is None else sc
+                    try:
+                        client.create("configmaps", cm(name, "t1", str(i)))
+                        acked.append(name)
+                        if killed_at is not None and promoted_at is None:
+                            promoted_at = time.perf_counter()
+                        break
+                    except Exception as e:
+                        from kcp_tpu.utils import errors as kerrors
+
+                        if isinstance(e, kerrors.AlreadyExistsError):
+                            acked.append(name)
+                            break
+                        if time.time() > stop:
+                            raise
+                        time.sleep(0.02)
+            items, _rv = sc.list("configmaps", namespace="default")
+            names = {o["metadata"]["name"] for o in items}
+            st = status(s.address)
+            drill = {
+                "acked_writes": len(acked),
+                "lost_after_promotion": len(
+                    [n for n in acked if n not in names]),
+                "promote_ms": round((promoted_at - killed_at) * 1e3, 1)
+                if promoted_at else None,
+                "promoted_role": st["role"],
+                "epoch": st["epoch"],
+            }
+            pc.close()
+            sc.close()
+        finally:
+            s.stop()
+            p.stop()
+
+    top = str(max(counts))
+    out = {
+        "metric": "replica_read_capacity_speedup",
+        "value": speedup.get(top, 1.0),
+        "unit": "x",
+        "stage": "replica-bench",
+        "replica_bench": {
+            **results,
+            "read_capacity_rps": capacities,
+            "capacity_speedup": speedup,
+            "bytes_equal": bytes_equal,
+            "lag": lag_stats,
+            "kill": drill,
+        },
+    }
+    emit(out)
+    return 0
+
+
 # ---------------------------------------------------------------------------
 # Orchestrator: the TPU rides a tunnel that wedges transiently, and a hung
 # in-process backend init cannot be interrupted from within. So the default
@@ -2075,7 +2292,7 @@ if __name__ == "__main__":
         # touches jax; shards are separate kcp processes)
         sys.exit(shard_loadgen())
     if ("--store" in args or "--admission" in args or "--encode" in args
-            or "--sharded" in args):
+            or "--sharded" in args or "--replica" in args):
         # pure-host microbenches: pin CPU (never touch the tunnel)
         # and run in-process — no watchdog child needed
         try:
@@ -2087,6 +2304,7 @@ if __name__ == "__main__":
         sys.exit(store_bench() if "--store" in args
                  else admission_bench() if "--admission" in args
                  else sharded_bench() if "--sharded" in args
+                 else replica_bench() if "--replica" in args
                  else encode_bench())
     if "--probe" in args:
         # manual diagnostic: always run in-process (never through the

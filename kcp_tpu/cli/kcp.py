@@ -58,18 +58,43 @@ def build_parser():
                             "(reference: --auto_publish_apis)")
     start.add_argument("--resources-to-sync", default="deployments.apps",
                        help="comma-separated resources synced to physical clusters")
-    start.add_argument("--role", choices=["shard", "router"], default="shard",
+    start.add_argument("--role",
+                       choices=["shard", "router", "replica", "standby"],
+                       default="shard",
                        help="shard: a normal control-plane server (the "
                             "default; shards of a fleet are just servers). "
                             "router: the sharded control plane's scatter-"
                             "gather frontend — no storage, no controllers; "
                             "single-cluster requests proxy to the owning "
                             "shard, wildcard list/watch merge across all "
-                            "shards (kcp_tpu/sharding/)")
+                            "shards (kcp_tpu/sharding/). "
+                            "replica: a read-only follower replaying the "
+                            "--primary server's WAL feed, serving GET/LIST/"
+                            "WATCH RV-honestly from its own store. "
+                            "standby: a replica that promotes itself to "
+                            "primary (fencing the old one) when the "
+                            "primary stays dead past the hysteresis "
+                            "window (kcp_tpu/replication/)")
     start.add_argument("--shards", default="",
                        help="router role: comma-separated [name=]url shard "
                             "list (env KCP_SHARDS is the fallback), e.g. "
-                            "s0=http://h0:6443,s1=http://h1:6443")
+                            "s0=http://h0:6443,s1=http://h1:6443; a shard "
+                            "entry may append |-separated read replicas, "
+                            "e.g. s0=http://h0:6443|http://h0r:6444")
+    start.add_argument("--primary", default="",
+                       help="replica/standby roles: the primary server's "
+                            "base URL (the /replication/wal feed source "
+                            "and promotion health-probe target)")
+    start.add_argument("--repl-hysteresis", type=float, default=None,
+                       help="standby promotion hysteresis seconds (env "
+                            "KCP_REPL_HYSTERESIS_S, default 3.0): how long "
+                            "the primary's breaker must stay open before "
+                            "the standby fences it and takes writes")
+    start.add_argument("--repl-lag-max", type=int, default=None,
+                       help="replica reads answer 503 past this many "
+                            "records of replication lag (env "
+                            "KCP_REPL_LAG_MAX; default 0 = serve any "
+                            "staleness, RV-honestly)")
     start.add_argument("--store-server", default="",
                        help="serve against another kcp-tpu server's "
                             "storage (the --etcd-servers analog): this "
@@ -150,6 +175,9 @@ def config_from_args(args) -> Config:
         store_ca_file=args.store_ca_file,
         role=args.role,
         shards=args.shards,
+        primary=args.primary,
+        repl_hysteresis_s=args.repl_hysteresis,
+        repl_lag_max=args.repl_lag_max,
         poll_interval=args.poll_interval,
         import_poll_interval=args.poll_interval,
         authz=args.authz,

@@ -52,6 +52,16 @@ Injection points wired in this codebase:
                                  (error = a shard relay answers 503,
                                  latency = a slow shard hop — the chaos
                                  lever for shard-death drills)
+    repl.ship                    replication/hub.py WAL feed (error =
+                                 the ship stream dies and the follower
+                                 reconnects, latency = ship lag)
+    repl.apply                   replication/applier.py record apply
+                                 (error = the follower drops the feed
+                                 and re-resumes from its applied RV)
+    repl.promote                 replication/applier.py standby
+                                 promotion (error = the promotion
+                                 attempt aborts and retries after the
+                                 next probe cycle)
 
 Sites call the module-level helpers, which are near-free no-ops when no
 injector is active (one global read).
@@ -95,6 +105,9 @@ POINTS = frozenset({
     "admission.flow",
     "encode.cache",
     "router.proxy",
+    "repl.ship",
+    "repl.apply",
+    "repl.promote",
 })
 
 

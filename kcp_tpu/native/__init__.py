@@ -63,6 +63,11 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.ws_count.argtypes = [ctypes.c_void_p]
     lib.ws_flush.restype = ctypes.c_int
     lib.ws_flush.argtypes = [ctypes.c_void_p]
+    lib.ws_epoch.restype = ctypes.c_uint64
+    lib.ws_epoch.argtypes = [ctypes.c_void_p]
+    lib.ws_set_epoch.restype = ctypes.c_int
+    lib.ws_set_epoch.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.ws_set_rv.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
     lib.ws_snapshot.restype = ctypes.c_int
     lib.ws_snapshot.argtypes = [ctypes.c_void_p]
     lib.ws_snapshot_begin.restype = ctypes.c_int
@@ -169,6 +174,23 @@ class WalEngine:
     @property
     def rv(self) -> int:
         return self._lib.ws_rv(self._h)
+
+    @property
+    def epoch(self) -> int:
+        """Replication epoch persisted in the log (0 = never stamped)."""
+        return self._lib.ws_epoch(self._h)
+
+    def set_epoch(self, epoch: int) -> None:
+        """Durably stamp a replication epoch (fsynced before return —
+        fences and promotions must not be lost to a crash)."""
+        if self._lib.ws_set_epoch(self._h, epoch) != 0:
+            raise OSError(self._lib.ws_last_error(self._h).decode())
+
+    def set_rv(self, rv: int) -> None:
+        """Advance the RV watermark without a mutation record (snapshot
+        resync: objects arrive with their own RVs, the barrier carries
+        the authoritative watermark)."""
+        self._lib.ws_set_rv(self._h, rv)
 
     def __len__(self) -> int:
         return self._lib.ws_count(self._h)

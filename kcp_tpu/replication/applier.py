@@ -1,0 +1,368 @@
+"""ReplicationApplier: the follower side of WAL shipping.
+
+Runs on a replica or standby server's event loop, keeps one chunked
+feed connection to the primary (``GET /replication/wal``), and applies
+every shipped record into the local store at the primary's exact RVs —
+watch events fan out locally (replica informers stay live), the record
+lands in the local WAL (replica durability), and ``repl_applied_rv`` /
+``repl_lag_records`` make the follower's honesty observable.
+
+Standby promotion rides the PR 2 circuit machinery: when the feed dies,
+the applier probes the primary's ``/healthz`` through a
+:class:`~kcp_tpu.utils.circuit.CircuitBreaker`; once the breaker is OPEN
+and stays open past the hysteresis window, the standby promotes — bumps
+the replication epoch (persisted with the WAL), opens the store for
+writes, and fences the old primary (best-effort POST
+``/replication/fence`` retried in the background) so a zombie coming
+back cannot commit.
+
+``repl.apply`` (error = the apply loop drops the connection and
+re-resumes from the applied RV) and ``repl.promote`` (error = the
+promotion attempt aborts and retries after the next probe cycle) are
+KCP_FAULTS injection points.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import http.client
+import json
+import logging
+from urllib.parse import urlsplit
+
+from ..faults import maybe_fail
+from ..server.rest import RestWatch, _status_error
+from ..utils import errors
+from ..utils.circuit import CircuitBreaker
+from ..utils.trace import REGISTRY
+
+log = logging.getLogger(__name__)
+
+
+class _FeedStream(RestWatch):
+    """The replication feed as parsed ndjson messages (reuses the
+    RestWatch chunked-transfer reassembly; the Event wrapping of the
+    watch wire format does not apply here)."""
+
+    def _handle_line(self, msg: dict) -> None:
+        self._events.put_nowait(msg)
+
+    async def next(self) -> dict | None:
+        self._ensure_started()
+        if self._closed and self._events.empty():
+            return None
+        item = await self._events.get()
+        if item is None:
+            self._events.put_nowait(None)
+            return None
+        return item
+
+    def drain_msgs(self) -> list[dict]:
+        out: list[dict] = []
+        while not self._events.empty():
+            item = self._events.get_nowait()
+            if item is None:
+                self._events.put_nowait(None)
+                break
+            out.append(item)
+        return out
+
+
+class ReplicationApplier:
+    """Follow a primary's WAL feed into a local LogicalStore."""
+
+    def __init__(self, store, primary_url: str, role: str = "replica",
+                 token: str = "", ca_data=None, ca_file: str | None = None,
+                 hysteresis_s: float = 3.0, probe_interval_s: float = 0.3,
+                 on_promote=None):
+        if role not in ("replica", "standby"):
+            raise ValueError(f"unknown replication role {role!r}")
+        self.store = store
+        self.primary_url = primary_url.rstrip("/")
+        self.role = role
+        self.token = token
+        parts = urlsplit(self.primary_url)
+        self._host = parts.hostname or "127.0.0.1"
+        self._tls = parts.scheme == "https"
+        self._port = parts.port or (443 if self._tls else 80)
+        self._ssl = None
+        if self._tls:
+            from ..server.certs import client_context
+
+            self._ssl = client_context(ca_data, ca_file)
+        self.hysteresis_s = hysteresis_s
+        self.probe_interval_s = probe_interval_s
+        self.on_promote = on_promote
+        self.promoted = False
+        self.connected = False
+        self.last_seen_rv = 0  # primary's rv from the stream header/records
+        self._sub_id: int | None = None
+        self._stream_epoch = 0
+        self._task: asyncio.Task | None = None
+        self._fence_task: asyncio.Task | None = None
+        self._stopped = False
+        # the primary-death detector: transport probes through a breaker,
+        # exactly like any other dead-peer detection in this codebase
+        self.breaker = CircuitBreaker(
+            f"repl_primary_{self._host}_{self._port}", failure_threshold=3,
+            reset_timeout=probe_interval_s)
+        self._applied_gauge = REGISTRY.gauge(
+            "repl_applied_rv",
+            "highest primary RV this follower has applied")
+        self._lag_gauge = REGISTRY.gauge(
+            "repl_lag_records",
+            "records between the primary's last seen RV and this "
+            "follower's applied RV")
+        self._applied_total = REGISTRY.counter(
+            "repl_apply_records_total",
+            "WAL records applied from the replication feed")
+
+    # ------------------------------------------------------------ public
+
+    @property
+    def applied_rv(self) -> int:
+        return self.store.resource_version
+
+    @property
+    def lag_records(self) -> int:
+        return max(0, self.last_seen_rv - self.store.resource_version)
+
+    async def start(self) -> None:
+        self._task = asyncio.ensure_future(self._run())
+
+    async def stop(self) -> None:
+        self._stopped = True
+        for t in (self._task, self._fence_task):
+            if t is not None:
+                t.cancel()
+                try:
+                    await t
+                except (asyncio.CancelledError, Exception):
+                    pass
+        self._task = self._fence_task = None
+
+    # -------------------------------------------------------------- loop
+
+    async def _run(self) -> None:
+        down_since: float | None = None
+        loop = asyncio.get_running_loop()
+        while not self._stopped and not self.promoted:
+            try:
+                streamed = await self._follow_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # injected apply faults, garbled feed
+                log.warning("replication feed error: %s", e)
+                streamed = False
+            if self._stopped or self.promoted:
+                return
+            if streamed:
+                down_since = None  # we WERE connected; restart the clock
+            healthy = await loop.run_in_executor(None, self._probe_blocking)
+            if healthy:
+                self.breaker.record_success()
+                down_since = None
+            else:
+                self.breaker.record_failure()
+                if down_since is None:
+                    down_since = loop.time()
+                from ..utils.circuit import OPEN
+
+                if (self.role == "standby"
+                        and self.breaker.state == OPEN
+                        and loop.time() - down_since >= self.hysteresis_s):
+                    try:
+                        await self._promote()
+                        return
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception as e:
+                        # injected repl.promote fault (or a transient
+                        # persistence failure): retry next cycle — the
+                        # hysteresis clock keeps running
+                        log.warning("promotion attempt aborted: %s", e)
+            await asyncio.sleep(self.probe_interval_s)
+
+    def _probe_blocking(self) -> bool:
+        """One short-timeout /healthz probe (executor thread)."""
+        conn = None
+        try:
+            if self._tls:
+                conn = http.client.HTTPSConnection(
+                    self._host, self._port, timeout=1.0, context=self._ssl)
+            else:
+                conn = http.client.HTTPConnection(
+                    self._host, self._port, timeout=1.0)
+            conn.request("GET", "/healthz")
+            resp = conn.getresponse()
+            resp.read()
+            return resp.status == 200
+        except (ConnectionError, OSError, http.client.HTTPException):
+            return False
+        finally:
+            if conn is not None:
+                conn.close()
+
+    async def _follow_once(self) -> bool:
+        """One feed connection: catch up, then apply live records until
+        the stream dies. Returns True if the stream delivered a valid
+        header (i.e. the primary was alive at some point)."""
+        query = (f"sinceRV={self.store.resource_version}"
+                 f"&epoch={self.store.epoch}&role={self.role}")
+        ws = _FeedStream(self._host, self._port,
+                         f"/replication/wal?{query}", "",
+                         token=self.token, ssl_context=self._ssl)
+        got_header = False
+        in_snapshot = False
+        try:
+            while True:
+                msg = await ws.next()
+                if msg is None:
+                    self.connected = False
+                    return got_header
+                batch = [msg, *ws.drain_msgs()]
+                delay = maybe_fail("repl.apply")
+                if delay:
+                    await asyncio.sleep(delay)
+                applied = 0
+                for m in batch:
+                    mtype = m.get("type")
+                    if mtype == "HEADER":
+                        got_header = True
+                        self.connected = True
+                        self._sub_id = m.get("sub")
+                        self._stream_epoch = int(m.get("epoch", 0))
+                        if self._stream_epoch < self.store.epoch:
+                            # a zombie primary from a fenced epoch: its
+                            # feed must not rewind us (the hub normally
+                            # self-fences first, but never trust a wire)
+                            REGISTRY.counter(
+                                "repl_fenced_writes_total").inc()
+                            raise errors.GoneError(
+                                f"feed epoch {self._stream_epoch} < local "
+                                f"epoch {self.store.epoch}; refusing")
+                        if self._stream_epoch > self.store.epoch:
+                            self.store.set_epoch(self._stream_epoch)
+                        self.last_seen_rv = max(self.last_seen_rv,
+                                                int(m.get("rv", 0)))
+                        if m.get("snapshot"):
+                            in_snapshot = True
+                            self.store.reset_for_resync()
+                    elif mtype == "SNAP":
+                        self.store.load_snapshot_object(m["key"], m["obj"])
+                    elif mtype == "BARRIER":
+                        in_snapshot = False
+                        self.store.finish_resync(int(m["rv"]))
+                        applied += 1
+                    elif mtype == "ERROR":
+                        obj = m.get("object") or {}
+                        raise _status_error(obj.get("code", 410),
+                                            obj.get("reason", ""),
+                                            obj.get("message", ""))
+                    else:  # a WAL record
+                        rv = int(m.get("rv", 0))
+                        self.last_seen_rv = max(self.last_seen_rv, rv)
+                        if self.store.apply_replicated(
+                                m, epoch=self._stream_epoch):
+                            applied += 1
+                if applied:
+                    self._applied_total.inc(applied)
+                self._applied_gauge.set(self.store.resource_version)
+                self._lag_gauge.set(self.lag_records)
+                if applied and not in_snapshot and self.role == "standby" \
+                        and self._sub_id is not None:
+                    await self._send_ack()
+        finally:
+            ws.close()
+            self.connected = False
+
+    async def _send_ack(self) -> None:
+        """Report the applied RV to the primary (semi-sync commits)."""
+        sid, rv = self._sub_id, self.store.resource_version
+        await asyncio.get_running_loop().run_in_executor(
+            None, self._ack_blocking, sid, rv)
+
+    def _ack_blocking(self, sid: int, rv: int) -> None:
+        conn = None
+        try:
+            if self._tls:
+                conn = http.client.HTTPSConnection(
+                    self._host, self._port, timeout=5.0, context=self._ssl)
+            else:
+                conn = http.client.HTTPConnection(
+                    self._host, self._port, timeout=5.0)
+            body = json.dumps({"sub": sid, "rv": rv}).encode()
+            headers = {"Content-Type": "application/json"}
+            if self.token:
+                headers["Authorization"] = f"Bearer {self.token}"
+            conn.request("POST", "/replication/ack", body=body,
+                         headers=headers)
+            conn.getresponse().read()
+        except (ConnectionError, OSError, http.client.HTTPException):
+            pass  # best-effort: a lost ack only delays the sync floor
+        finally:
+            if conn is not None:
+                conn.close()
+
+    # --------------------------------------------------------- promotion
+
+    async def _promote(self) -> None:
+        """Fence the old epoch, open for writes, become the primary."""
+        delay = maybe_fail("repl.promote")
+        if delay:
+            await asyncio.sleep(delay)
+        new_epoch = self.store.epoch + 1
+        self.store.set_epoch(new_epoch)  # durable BEFORE serving writes
+        self.store.read_only = None
+        self.store.fenced = False
+        self.store.reject_future_rv = False
+        self.promoted = True
+        REGISTRY.counter(
+            "repl_promotions_total",
+            "standby promotions to primary").inc()
+        log.warning("standby PROMOTED to primary at epoch %d (rv %d); "
+                    "fencing %s", new_epoch, self.store.resource_version,
+                    self.primary_url)
+        if self.on_promote is not None:
+            self.on_promote()
+        self._fence_task = asyncio.ensure_future(
+            self._fence_old_primary(new_epoch))
+
+    async def _fence_old_primary(self, epoch: int) -> None:
+        """Best-effort fence of the superseded primary, retried with
+        backoff: if the old process ever comes back as a zombie, its
+        store goes read-only before a client can land a write on it."""
+        backoff = 0.5
+        while not self._stopped:
+            ok = await asyncio.get_running_loop().run_in_executor(
+                None, self._fence_blocking, epoch)
+            if ok:
+                log.info("old primary %s fenced at epoch %d",
+                         self.primary_url, epoch)
+                return
+            await asyncio.sleep(backoff)
+            backoff = min(backoff * 2, 5.0)
+
+    def _fence_blocking(self, epoch: int) -> bool:
+        conn = None
+        try:
+            if self._tls:
+                conn = http.client.HTTPSConnection(
+                    self._host, self._port, timeout=2.0, context=self._ssl)
+            else:
+                conn = http.client.HTTPConnection(
+                    self._host, self._port, timeout=2.0)
+            body = json.dumps({"epoch": epoch}).encode()
+            headers = {"Content-Type": "application/json"}
+            if self.token:
+                headers["Authorization"] = f"Bearer {self.token}"
+            conn.request("POST", "/replication/fence", body=body,
+                         headers=headers)
+            resp = conn.getresponse()
+            resp.read()
+            return 200 <= resp.status < 300
+        except (ConnectionError, OSError, http.client.HTTPException):
+            return False
+        finally:
+            if conn is not None:
+                conn.close()

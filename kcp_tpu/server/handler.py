@@ -127,6 +127,16 @@ class RestHandler:
         # per-record cache underneath.
         self._list_cache: dict[tuple, tuple[int, bytes]] = {}
         self._list_cache_max = 8
+        # HA replication (kcp_tpu/replication/): the Server wires these.
+        # repl_hub — primary-side WAL shipper (feed + acks + fencing);
+        # repl_applier — follower-side applier (replica/standby roles);
+        # repl_role — what /replication/status reports;
+        # repl_lag_max — replicas refuse reads 503 past this lag
+        # (KCP_REPL_LAG_MAX; 0 = serve any staleness, RV-honestly).
+        self.repl_hub = None
+        self.repl_applier = None
+        self.repl_role = "primary"
+        self.repl_lag_max = 0
 
     async def _st(self, fn, *args, **kwargs):
         """Run a store call; offloaded to the I/O pool for remote stores."""
@@ -247,6 +257,8 @@ class RestHandler:
                 "started": bool(started),
                 "hint": "view with xprof/tensorboard --logdir",
             })
+        if head == "replication":
+            return await self._replication(req, segs[1:])
         if head == "api":
             return await self._route_group(req, cluster, group="", segs=segs[1:])
         if head == "apis":
@@ -416,6 +428,7 @@ class RestHandler:
         if req.method == "GET":
             from ..apis.printers import render_table, wants_table
 
+            self._check_replica_lag()
             as_table = wants_table(req.headers.get("accept", ""))
             if name is None:
                 if req.param("watch") in ("true", "1"):
@@ -468,6 +481,7 @@ class RestHandler:
                 ticket.fail()
                 raise
             ticket.ok()
+            await self._repl_wait()
             return Response.of_json(self._stamp(created, info, gv), 201)
 
         if req.method == "PUT" and name is not None:
@@ -494,6 +508,7 @@ class RestHandler:
                 ticket.fail()
                 raise
             ticket.ok()
+            await self._repl_wait()
             return Response.of_json(self._stamp(updated, info, gv))
 
         if req.method == "DELETE" and name is not None:
@@ -510,6 +525,7 @@ class RestHandler:
                 ticket.fail()
                 raise
             ticket.ok()
+            await self._repl_wait()
             return Response.of_json(_status_body(200, "Deleted", f"{res} {name} deleted"))
 
         raise errors.BadRequestError(f"unsupported method {req.method} for {req.path}")
@@ -633,6 +649,115 @@ class RestHandler:
             return True
         except errors.NotFoundError:
             return False
+
+    # -------------------------------------------------------- replication
+
+    async def _replication(self, req: Request, segs: list[str]):
+        """The WAL-shipping surface (kcp_tpu/replication/):
+
+        - ``GET  /replication/wal``    chunked record feed (followers)
+        - ``GET  /replication/status`` role/epoch/applied-RV/lag probe
+        - ``POST /replication/ack``    standby applied-RV report
+        - ``POST /replication/fence``  epoch fence (promotion kill switch)
+
+        The feed carries every tenant's objects and the fence can stop
+        a primary cold, so everything but ``status`` is gated like the
+        other server-global surfaces (/debug, /clusters).
+        """
+        if segs == ["status"] and req.method == "GET":
+            st = self.store
+            body = {
+                "role": self.repl_role,
+                "epoch": getattr(st, "epoch", 0),
+                "applied_rv": getattr(st, "resource_version", 0),
+                "read_only": getattr(st, "read_only", None),
+                "fenced": bool(getattr(st, "fenced", False)),
+            }
+            ap = self.repl_applier
+            if ap is not None:
+                body["lag_records"] = ap.lag_records
+                body["connected"] = ap.connected
+                body["primary"] = ap.primary_url
+            if self.repl_hub is not None:
+                body["subscribers"] = len(self.repl_hub._subs)
+            return Response.of_json(body)
+        if not await self._server_scope_allowed(req):
+            user = (self.authenticator.user_for(req.headers)
+                    if self.authenticator else "anonymous")
+            return Response.of_json(
+                _status_body(403, "Forbidden",
+                             f'user "{user}" cannot access replication'),
+                403)
+        if self.repl_hub is None:
+            return _error_response(errors.NotFoundError(
+                "no replication hub on this server (routers and "
+                "remote-store frontends do not ship a WAL)"))
+        if segs == ["wal"] and req.method == "GET":
+            try:
+                since_rv = int(req.param("sinceRV", "0") or "0")
+                sub_epoch = int(req.param("epoch", "0") or "0")
+            except ValueError as e:
+                raise errors.BadRequestError(
+                    f"malformed replication params: {e}") from e
+            role = req.param("role", "replica")
+            if role not in ("replica", "standby"):
+                raise errors.BadRequestError(
+                    f"unknown replication role {role!r}")
+            hub = self.repl_hub
+
+            async def produce(stream: StreamResponse) -> None:
+                try:
+                    await hub.serve_feed(stream, since_rv, sub_epoch, role)
+                except errors.ApiError as e:
+                    await stream.send_json({
+                        "type": "ERROR",
+                        "object": _status_body(e.code, e.reason, e.message)})
+
+            return StreamResponse(produce)
+        if segs == ["ack"] and req.method == "POST":
+            body = self._body_object(req)
+            self.repl_hub.ack(int(body.get("sub", 0)),
+                              int(body.get("rv", 0)))
+            return Response.of_json(_status_body(200, "OK", "acked"))
+        if segs == ["fence"] and req.method == "POST":
+            body = self._body_object(req)
+            epoch = int(body.get("epoch", 0))
+            if epoch < self.store.epoch:
+                # a stale fence (e.g. from a promotion that itself got
+                # superseded) must not stick: epochs only move forward
+                raise errors.ConflictError(
+                    f"fence epoch {epoch} is older than this store's "
+                    f"epoch {self.store.epoch}")
+            if epoch > self.store.epoch:
+                self.store.fence(epoch)
+            # equal epoch: idempotent retry of an applied fence (or a
+            # no-op against the current epoch's own primary)
+            return Response.of_json(_status_body(
+                200, "OK",
+                f"epoch {self.store.epoch}"
+                + (" (fenced)" if self.store.fenced else "")))
+        return _error_response(
+            errors.NotFoundError(f"unknown path {req.path}"))
+
+    async def _repl_wait(self) -> None:
+        """Semi-sync commit: with a standby attached, a write is only
+        acknowledged once the standby has applied it — the property the
+        kill-the-primary drill measures as zero acknowledged-write
+        loss. No standby, no wait (async replication)."""
+        hub = self.repl_hub
+        if hub is not None and hub.has_sync_subscribers:
+            await hub.wait_committed(self.store.resource_version)
+
+    def _check_replica_lag(self) -> None:
+        """Reads on a replica past KCP_REPL_LAG_MAX refuse 503 — for
+        consumers that prefer unavailability over staleness; the
+        default (0) serves any staleness RV-honestly."""
+        ap = self.repl_applier
+        if (self.repl_lag_max and ap is not None
+                and ap.lag_records > self.repl_lag_max):
+            raise errors.UnavailableError(
+                f"replica lag {ap.lag_records} records exceeds "
+                f"KCP_REPL_LAG_MAX={self.repl_lag_max}; read the primary")
 
     # -------------------------------------------------------------- watch
 

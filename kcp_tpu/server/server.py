@@ -74,9 +74,23 @@ class Config:
     # (ops/pallas_kernels.py) instead of the XLA lanes (single-device)
     role: str = "shard"  # shard (a normal server — the default) | router
     # (the sharded control plane's scatter-gather frontend: no storage,
-    # no controllers; every request routes over the shard ring)
+    # no controllers; every request routes over the shard ring) |
+    # replica (read-only follower fed by a primary's WAL feed, serving
+    # GET/LIST/WATCH RV-honestly from its own store + encode cache) |
+    # standby (a replica that promotes itself to primary when the
+    # primary's breaker stays open past the hysteresis window)
     shards: str = ""  # router role: comma-separated [name=]url shard list
     # (KCP_SHARDS env is the fallback; see kcp_tpu/sharding/ring.py)
+    primary: str = ""  # replica/standby roles: the primary's base URL
+    # (the /replication/wal feed source and the health-probe target)
+    repl_hysteresis_s: float | None = None  # standby promotion: how long
+    # the primary's breaker must stay open before the standby promotes
+    # (None -> KCP_REPL_HYSTERESIS_S, default 3.0s). Too low and a slow
+    # GC pause triggers a split brain race the fence then has to win;
+    # too high and writes are down that much longer.
+    repl_lag_max: int | None = None  # replicas refuse reads 503 past
+    # this many records of lag (None -> KCP_REPL_LAG_MAX, default 0 =
+    # serve any staleness RV-honestly)
 
 
 class Server:
@@ -90,11 +104,16 @@ class Server:
         # resolve the install_controllers tri-state once (see Config):
         # frontends serving someone else's storage default to serve-only,
         # and a router (no storage at all) can never run controllers
+        # routers own no storage; replicas/standbys serve a replicated
+        # store that in-process controllers would fight the primary's
+        # controllers over — none of the three may run controllers
         self.install_controllers = (
-            False if self.config.role == "router"
+            False if self.config.role in ("router", "replica", "standby")
             else self.config.install_controllers
             if self.config.install_controllers is not None
             else not self.config.store_server)
+        self.repl_hub = None
+        self.repl_applier = None
         if self.config.role == "router":
             # scatter-gather frontend over a shard ring: no store, no
             # controllers — requests relay to the owning shard(s). Authz
@@ -113,7 +132,8 @@ class Server:
                 raise ValueError("--store-server with --role router: a "
                                  "router routes to --shards, not to a "
                                  "storage backend")
-            ring = (ShardRing.from_spec(self.config.shards)
+            ring = (ShardRing.from_spec(self.config.shards,
+                                        os.environ.get("KCP_REPLICAS", ""))
                     if self.config.shards else ShardRing.from_env())
             self.store = None
             self.authenticator = None
@@ -143,6 +163,16 @@ class Server:
             self._post_start_hooks = []
             self._stop = asyncio.Event()
             return
+        if self.config.role in ("replica", "standby"):
+            if not self.config.primary:
+                raise ValueError(
+                    f"--role {self.config.role} needs --primary (the "
+                    f"primary server's base URL to follow)")
+            if self.config.store_server:
+                raise ValueError(
+                    "--store-server with --role replica/standby: a "
+                    "follower replays the primary's WAL into its OWN "
+                    "store; it cannot also delegate storage elsewhere")
         if self.config.store_server:
             # external storage: this process is a stateless frontend; the
             # backend's store owns RVs, conflicts, finalizers, and the WAL
@@ -199,8 +229,13 @@ class Server:
             authn = Authenticator(tokens={self.config.admin_token: ADMIN_USER})
             authz = Authorizer(self.store)
         self.authenticator = authn
-        self.handler = RestHandler(self.store, self.scheme,
-                                   authenticator=authn, authorizer=authz)
+        self.handler = RestHandler(
+            self.store, self.scheme, authenticator=authn, authorizer=authz,
+            # a replica never serves a write (the store refuses them
+            # anyway), so its admission chain would be dead weight; a
+            # standby keeps the default chain for life after promotion
+            admission=(None if self.config.role == "replica" else "auto"))
+        self._wire_replication()
         self.certs = None
         ssl_context = None
         if self.config.tls:
@@ -218,6 +253,48 @@ class Server:
         self._controllers: list = []
         self._post_start_hooks: list = []
         self._stop = asyncio.Event()
+
+    def _wire_replication(self) -> None:
+        """Attach the WAL-shipping hub (every server with a local store
+        can feed replicas) and, for replica/standby roles, the applier
+        that follows the configured primary."""
+        from ..store import LogicalStore
+
+        if not isinstance(self.store, LogicalStore):
+            return  # remote-store frontends ship nothing: the backend does
+        from ..replication import ReplicationApplier, ReplicationHub
+
+        self.repl_hub = ReplicationHub(self.store)
+        self.handler.repl_hub = self.repl_hub
+        role = self.config.role
+        if role not in ("replica", "standby"):
+            return
+        self.store.read_only = (
+            "replica serves reads only; writes go to the primary"
+            if role == "replica"
+            else "standby awaiting promotion; writes go to the primary")
+        self.store.reject_future_rv = True
+        hysteresis = (self.config.repl_hysteresis_s
+                      if self.config.repl_hysteresis_s is not None
+                      else float(os.environ.get("KCP_REPL_HYSTERESIS_S",
+                                                "3.0")))
+        lag_max = (self.config.repl_lag_max
+                   if self.config.repl_lag_max is not None
+                   else int(os.environ.get("KCP_REPL_LAG_MAX", "0")))
+
+        def on_promote() -> None:
+            self.handler.repl_role = "primary"
+            log.warning("this server is now the PRIMARY (epoch %d)",
+                        self.store.epoch)
+
+        self.repl_applier = ReplicationApplier(
+            self.store, self.config.primary, role=role,
+            token=self.config.store_token,
+            ca_file=self.config.store_ca_file,
+            hysteresis_s=hysteresis, on_promote=on_promote)
+        self.handler.repl_applier = self.repl_applier
+        self.handler.repl_role = role
+        self.handler.repl_lag_max = lag_max
 
     def add_post_start_hook(self, hook) -> None:
         """Register an async callable fired once serving (server.go:294-312)."""
@@ -250,6 +327,8 @@ class Server:
                               ca_pem=self.certs.ca_cert_pem if self.certs else None)
         if self.install_controllers:
             await self._install_controllers()
+        if self.repl_applier is not None:
+            await self.repl_applier.start()
         for hook in self._post_start_hooks:
             await hook(self)
         self.handler.ready = True
@@ -336,6 +415,15 @@ class Server:
     def stop(self) -> None:
         self._stop.set()
 
+    def kill(self) -> None:
+        """Abrupt-death switch (the in-process SIGKILL emulation the
+        kill-the-primary drills use): serving stops immediately and the
+        shutdown skips WAL compaction — on-disk state is exactly the
+        appended log a killed process leaves, which is what restart and
+        standby promotion must recover from."""
+        self._killed = True
+        self._stop.set()
+
     async def shutdown(self) -> None:
         if getattr(self, "_watchdog", None) is not None:
             self._watchdog.stop()
@@ -343,6 +431,9 @@ class Server:
         if getattr(self, "_set_pallas_env", False):
             os.environ.pop("KCP_PALLAS", None)
             self._set_pallas_env = False
+        if self.repl_applier is not None:
+            await self.repl_applier.stop()
+            self.repl_applier = None
         for c in reversed(self._controllers):
             await c.stop()
         self._controllers = []
@@ -359,6 +450,6 @@ class Server:
         await self.http.stop()
         self.handler.close()
         if self.store is not None:
-            if self.config.durable:
+            if self.config.durable and not getattr(self, "_killed", False):
                 self.store.snapshot()
             self.store.close()

@@ -79,6 +79,18 @@ class ServerThread:
 
         return self.submit(_wrap())
 
+    def kill(self) -> None:
+        """Abrupt stop (SIGKILL emulation for kill drills): no WAL
+        compaction, in-flight streams die mid-chunk. See Server.kill."""
+        if self._loop is not None and self.server is not None:
+            try:
+                self._loop.call_soon_threadsafe(self.server.kill)
+            except RuntimeError:
+                pass
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+
     def stop(self) -> None:
         if self._loop is not None and self.server is not None:
             try:

@@ -28,10 +28,13 @@ DEFAULT_SHARDS_ENV = "KCP_SHARDS"
 
 @dataclass(frozen=True)
 class Shard:
-    """One shard server: a stable identity + its base URL."""
+    """One shard server: a stable identity + its primary's base URL,
+    plus any read replicas fed by that primary's WAL (the router routes
+    plain reads to them; writes and RV-resumes stay on the primary)."""
 
     name: str
     url: str
+    replicas: tuple[str, ...] = ()
 
 
 def _weight(shard_name: str, cluster: str) -> int:
@@ -76,10 +79,18 @@ class ShardRing:
         return self.shards[self.owner_index(cluster)]
 
     @classmethod
-    def from_spec(cls, spec: str) -> "ShardRing":
+    def from_spec(cls, spec: str, replicas: str = "") -> "ShardRing":
         """Parse a shard-list spec: comma-separated ``name=url`` entries
         (bare URLs get ``shard<i>`` names). This is the ``KCP_SHARDS``
-        format and the ``kcp start --role router --shards`` argument."""
+        format and the ``kcp start --role router --shards`` argument.
+
+        A shard entry may append ``|``-separated read-replica URLs:
+        ``s0=http://h0:6443|http://h0r:6444`` — the first URL is the
+        primary (the ring hashes names, so replicas never change
+        ownership). ``replicas`` (the ``KCP_REPLICAS`` format) is an
+        alternative per-shard mapping, ``;``-separated
+        ``name=url[|url...]`` entries, merged after the inline form.
+        """
         shards: list[Shard] = []
         for i, entry in enumerate(s.strip() for s in spec.split(",")):
             if not entry:
@@ -87,10 +98,33 @@ class ShardRing:
             name, sep, url = entry.partition("=")
             if not sep:
                 name, url = f"shard{i}", entry
-            if "://" not in url:
+            urls = [u.strip().rstrip("/") for u in url.split("|") if u.strip()]
+            if not urls or any("://" not in u for u in urls):
                 raise ValueError(
-                    f"shard entry {entry!r}: expected [name=]http[s]://host:port")
-            shards.append(Shard(name.strip(), url.strip().rstrip("/")))
+                    f"shard entry {entry!r}: expected "
+                    f"[name=]http[s]://host:port[|replica-url...]")
+            shards.append(Shard(name.strip(), urls[0], tuple(urls[1:])))
+        if replicas:
+            by_name = {s.name: s for s in shards}
+            for entry in (e.strip() for e in replicas.split(";")):
+                if not entry:
+                    continue
+                name, sep, urls_raw = entry.partition("=")
+                name = name.strip()
+                if not sep or name not in by_name:
+                    raise ValueError(
+                        f"replica entry {entry!r}: expected "
+                        f"<shard-name>=url[|url...] naming a shard in the "
+                        f"ring ({sorted(by_name)})")
+                extra = tuple(u.strip().rstrip("/")
+                              for u in urls_raw.split("|") if u.strip())
+                if any("://" not in u for u in extra):
+                    raise ValueError(
+                        f"replica entry {entry!r}: URLs must be "
+                        f"http[s]://host:port")
+                s = by_name[name]
+                by_name[name] = Shard(s.name, s.url, s.replicas + extra)
+            shards = [by_name[s.name] for s in shards]
         return cls(shards)
 
     @classmethod
@@ -99,4 +133,4 @@ class ShardRing:
         if not spec:
             raise ValueError(
                 f"no shard list: set {DEFAULT_SHARDS_ENV} or pass --shards")
-        return cls.from_spec(spec)
+        return cls.from_spec(spec, os.environ.get("KCP_REPLICAS", ""))

@@ -131,6 +131,16 @@ class RouterHandler:
         self._pools = [ConnectionPool(s.url, token=token, ca_data=ca_data,
                                       ca_file=ca_file, cap=cap)
                        for s in ring]
+        # read replicas per shard (Shard.replicas — WAL-fed followers):
+        # plain single-cluster reads round-robin over them, writes and
+        # RV-resumes stay on the primary (a replica's applied RV may
+        # trail; it answers an honest 410 for resumes beyond it, and the
+        # router never manufactures freshness on its behalf)
+        self._rpools = [
+            [ConnectionPool(url, token=token, ca_data=ca_data,
+                            ca_file=ca_file, cap=cap) for url in s.replicas]
+            for s in ring]
+        self._rr = [0] * len(ring)
         # scatter concurrency: every shard must be reachable in parallel
         # or a wildcard fan-out serializes on the slowest round trip
         self._exec = ThreadPoolExecutor(
@@ -147,22 +157,35 @@ class RouterHandler:
         self._resumes = REGISTRY.counter(
             "router_watch_resumes_total",
             "merged wildcard watches resumed from a decoded vector RV")
+        self._replica_reads = REGISTRY.counter(
+            "router_replica_reads_total",
+            "single-cluster reads served by a shard's read replica")
+        self._replica_fallback = REGISTRY.counter(
+            "router_replica_fallback_total",
+            "replica reads that fell back to the primary (replica "
+            "unreachable or refusing)")
 
     def close(self) -> None:
         self._exec.shutdown(wait=False, cancel_futures=True)
         for p in self._pools:
             p.close()
+        for rp in self._rpools:
+            for p in rp:
+                p.close()
 
     # ----------------------------------------------------------- plumbing
 
     def _shard_call(self, idx: int, method: str, target: str,
                     payload: bytes | None, headers: dict[str, str],
+                    pool: ConnectionPool | None = None, who: str = "",
                     ) -> tuple[int, dict[str, str], bytes]:
-        """One raw relay round trip to shard ``idx`` (executor thread)."""
+        """One raw relay round trip to shard ``idx`` (executor thread);
+        ``pool`` overrides the primary pool for replica-routed reads."""
         delay = maybe_fail("router.proxy")
         if delay:
             time.sleep(delay)
-        pool = self._pools[idx]
+        if pool is None:
+            pool = self._pools[idx]
         t0 = time.perf_counter()
         try:
             with pool.client() as c:
@@ -175,15 +198,60 @@ class RouterHandler:
                 http.client.HTTPException) as e:
             self._unavailable.inc()
             raise errors.UnavailableError(
-                f"shard {self.ring.shards[idx].name} unreachable: {e}") from e
+                f"shard {who or self.ring.shards[idx].name} "
+                f"unreachable: {e}") from e
         finally:
             self._proxy_seconds.observe(time.perf_counter() - t0)
 
     async def _call(self, idx: int, method: str, target: str,
-                    payload: bytes | None, headers: dict[str, str]):
+                    payload: bytes | None, headers: dict[str, str],
+                    pool: ConnectionPool | None = None, who: str = ""):
         loop = asyncio.get_running_loop()
         return await loop.run_in_executor(
-            self._exec, self._shard_call, idx, method, target, payload, headers)
+            self._exec, self._shard_call, idx, method, target, payload,
+            headers, pool, who)
+
+    async def _read_via_replica(self, idx: int, target: str,
+                                req: Request) -> Response:
+        """A plain single-cluster read, round-robined over the owning
+        shard's replicas; primary fallback when every replica is
+        unreachable or refusing (503: lag gate, mid-promotion)."""
+        pools = self._rpools[idx]
+        n = len(pools)
+        start = self._rr[idx] % n
+        self._rr[idx] = (start + 1) % n
+        headers = self._fwd_headers(req)
+        for k in range(n):
+            j = (start + k) % n
+            who = f"{self.ring.shards[idx].name}/replica{j}"
+            try:
+                status, h, body = await self._call(
+                    idx, "GET", target, None, headers,
+                    pool=pools[j], who=who)
+            except errors.UnavailableError:
+                continue
+            if status == 503:
+                continue
+            self._replica_reads.inc()
+            return self._relay(status, h, body)
+        self._replica_fallback.inc()
+        status, h, body = await self._call(idx, "GET", target, None, headers)
+        return self._relay(status, h, body)
+
+    def _replica_watch_pool(self, idx: int,
+                            req: Request) -> ConnectionPool | None:
+        """A replica pool for a FRESH single-cluster watch (no resume
+        RV): the replica's stream is its own honest sequence. Resumes
+        carry an RV the client got from a primary-coherent read, so
+        they stay on the primary (a lagging replica would answer 410
+        beyond its applied RV — correct, but a needless relist)."""
+        pools = self._rpools[idx]
+        if not pools or req.param("resourceVersion"):
+            return None
+        j = self._rr[idx] % len(pools)
+        self._rr[idx] = (j + 1) % len(pools)
+        self._replica_reads.inc()
+        return pools[j]
 
     async def _scatter(self, method: str, target: str,
                        headers: dict[str, str]):
@@ -282,7 +350,13 @@ class RouterHandler:
             if cluster != WILDCARD:
                 idx = self.ring.owner_index(cluster)
                 if is_watch:
-                    return self._stream_proxy(idx, target, req)
+                    return self._stream_proxy(
+                        idx, target, req,
+                        pool=self._replica_watch_pool(idx, req))
+                if (req.method == "GET" and self._rpools[idx]
+                        and shape is not None
+                        and not req.param("resourceVersion")):
+                    return await self._read_via_replica(idx, target, req)
                 status, h, body = await self._call(
                     idx, req.method, target, req.body or None,
                     self._fwd_headers(req))
@@ -461,8 +535,10 @@ class RouterHandler:
 
     # -------------------------------------------------------------- watch
 
-    def _tap_watch(self, idx: int, target: str, req: Request) -> _TapWatch:
-        pool = self._pools[idx]
+    def _tap_watch(self, idx: int, target: str, req: Request,
+                   pool: ConnectionPool | None = None) -> _TapWatch:
+        if pool is None:
+            pool = self._pools[idx]
         parts = urlsplit(pool.base_url)
         host = parts.hostname or "127.0.0.1"
         port = parts.port or (443 if parts.scheme == "https" else 80)
@@ -471,15 +547,17 @@ class RouterHandler:
         return _TapWatch(host, port, target, "", token=token,
                          ssl_context=pool.ssl_context)
 
-    def _stream_proxy(self, idx: int, target: str, req: Request) -> StreamResponse:
+    def _stream_proxy(self, idx: int, target: str, req: Request,
+                      pool: ConnectionPool | None = None) -> StreamResponse:
         """Single-cluster watch: a byte-verbatim stream relay to the
         owning shard — every line (events, bookmarks, in-stream errors)
         passes through untouched, so resume RVs stay shard-local and
-        honest (the ring maps the cluster back to the same shard)."""
+        honest (the ring maps the cluster back to the same shard).
+        ``pool`` targets a read replica for fresh watches."""
         shard = self.ring.shards[idx]
 
         async def produce(stream: StreamResponse) -> None:
-            watch = self._tap_watch(idx, target, req)
+            watch = self._tap_watch(idx, target, req, pool=pool)
             try:
                 while True:
                     item = await watch.next()

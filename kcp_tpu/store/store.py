@@ -75,6 +75,7 @@ from __future__ import annotations
 import asyncio
 import copy
 import json
+import logging
 import os
 import time
 import uuid
@@ -92,9 +93,12 @@ from ..utils.errors import (
     GoneError,
     InvalidError,
     NotFoundError,
+    UnavailableError,
 )
 from ..utils.trace import REGISTRY, SIZE_BUCKETS
 from .selectors import LabelSelector, everything
+
+log = logging.getLogger(__name__)
 
 WILDCARD = "*"
 
@@ -381,6 +385,26 @@ class LogicalStore:
         # usage hook the QuotaLedger attaches (admission/quota.py). None
         # (the default) is one attribute read per mutation.
         self._usage_hook = None
+        # replication hook: called with every committed WAL record dict
+        # (both durability backends and in-memory stores alike) — the
+        # primary-side ReplicationHub attaches here to ship the log.
+        self._repl_hook = None
+        # read-only stores (replicas, standbys pre-promotion, fenced
+        # zombie primaries) refuse mutating verbs with a 503; None means
+        # writable, a string carries the human-readable reason. Fenced
+        # rejections are additionally counted (repl_fenced_writes_total).
+        self.read_only: str | None = None
+        self.fenced = False
+        # replication epoch: bumped on standby promotion and stamped on
+        # every shipped stream so a superseded primary's late records
+        # are rejected. Persisted with the WAL (epoch record / snapshot
+        # field / native OP_EPOCH) so a restart cannot rewind the fence.
+        self.epoch = 0
+        # RV honesty for replicas: a watch resume beyond the applied RV
+        # is knowledge this store does not have — with this flag set the
+        # watch answers a typed 410 instead of silently subscribing
+        # "live" at a point the client is already past.
+        self.reject_future_rv = False
         self._objects: dict[Key, dict] = {}
         self._rv = 0
         self._watches: list[Watch] = []
@@ -567,8 +591,22 @@ class LogicalStore:
 
     # --------------------------------------------------------------- CRUD
 
+    def _check_writable(self) -> None:
+        """Refuse mutations on read-only stores (replicas, unpromoted
+        standbys, fenced ex-primaries). 503 rather than 403: informers
+        and retrying clients treat it as a routing problem — the write
+        belongs on the current primary — not a policy denial."""
+        if self.read_only is not None:
+            if self.fenced:
+                REGISTRY.counter(
+                    "repl_fenced_writes_total",
+                    "writes refused because this store was fenced by a "
+                    "newer replication epoch").inc()
+            raise UnavailableError(f"store is read-only: {self.read_only}")
+
     def create(self, resource: str, cluster: str, obj: dict, namespace: str = "") -> dict:
         self._race_guard.check()
+        self._check_writable()
         _inject("store.put")
         obj = copy.deepcopy(obj)
         meta = obj.setdefault("metadata", {})
@@ -633,6 +671,7 @@ class LogicalStore:
         subresource: str | None = None,
     ) -> dict:
         self._race_guard.check()
+        self._check_writable()
         _inject("store.put")
         obj = copy.deepcopy(obj)
         meta = self._meta(obj)
@@ -699,6 +738,7 @@ class LogicalStore:
 
     def delete(self, resource: str, cluster: str, name: str, namespace: str = "") -> None:
         self._race_guard.check()
+        self._check_writable()
         _inject("store.delete")
         key = self._key(resource, cluster, namespace, name)
         existing = self._objects.get(key)
@@ -1035,6 +1075,15 @@ class LogicalStore:
         # must not be delivered live (the since_rv replay below covers
         # them from history when asked to)
         self._flush_events()
+        if (self.reject_future_rv and since_rv is not None
+                and since_rv > self._rv):
+            # RV-honest replica serving: the caller resumes from a point
+            # this store has not applied yet (it read a fresher primary).
+            # Never fabricate freshness — typed 410, the client re-lists
+            # (or the router retries against the primary).
+            raise GoneError(
+                f"requested rv {since_rv} is ahead of this replica's "
+                f"applied rv {self._rv}; re-list (or read the primary)")
         w = Watch(self, resource, cluster, namespace, selector or everything())
         if self._indexed and not w.selector.empty:
             self._subscribe_selector(w)
@@ -1304,7 +1353,19 @@ class LogicalStore:
 
     # ---------------------------------------------------------- durability
 
+    def set_repl_hook(self, hook) -> None:
+        """Install the per-commit replication callback ``hook(rec)``
+        (rec is the WAL record dict: op/key/rv and obj for puts). Fires
+        for every committed mutation regardless of durability backend —
+        the ReplicationHub ships exactly what the WAL records."""
+        self._repl_hook = hook
+
     def _log_wal(self, rec: dict) -> None:
+        # replication rides the WAL record stream: the hook sees every
+        # committed record (in-memory stores included — they still call
+        # _log_wal, they just have nowhere durable to put it)
+        if self._repl_hook is not None:
+            self._repl_hook(rec)
         if self._engine is not None:
             key = _wal_key(tuple(rec["key"]))
             if rec["op"] == "put":
@@ -1333,9 +1394,138 @@ class LogicalStore:
             parts = tuple(key.decode("utf-8").split("\x00"))
             self._put_obj(parts, json.loads(val))
         self._rv = self._engine.rv
+        self.epoch = max(self.epoch, getattr(self._engine, "epoch", 0))
         # journal-only mode: this store holds the authoritative objects,
         # so the engine's duplicate value map would only double memory
         self._engine.release_index()
+
+    # --------------------------------------------------------- replication
+
+    def set_epoch(self, epoch: int) -> None:
+        """Adopt a replication epoch (>= the current one; epochs never
+        rewind) and persist it with the WAL so a restart cannot undo a
+        fence or a promotion."""
+        epoch = int(epoch)
+        if epoch < self.epoch:
+            raise InvalidError(
+                f"epoch {epoch} < current {self.epoch}: epochs never rewind")
+        self.epoch = epoch
+        if self._engine is not None:
+            self._engine.set_epoch(epoch)
+        elif self._wal is not None and self._wal.fh is not None:
+            self._wal.fh.write(
+                json.dumps({"op": "epoch", "epoch": epoch},
+                           separators=(",", ":")) + "\n")
+            self._wal.fh.flush()
+
+    def fence(self, epoch: int) -> None:
+        """A newer epoch superseded this store (a standby promoted over
+        it): adopt the epoch and refuse all further writes. The zombie-
+        primary kill switch — after this, the old primary can neither
+        commit client writes nor ship records anywhere."""
+        self.set_epoch(epoch)
+        self.fenced = True
+        self.read_only = f"fenced: epoch {epoch} superseded this primary"
+        log.warning("store fenced at epoch %d: refusing writes", epoch)
+
+    def apply_replicated(self, rec: dict, epoch: int | None = None) -> bool:
+        """Apply one shipped WAL record exactly as the primary committed
+        it: the record's RV becomes this store's RV (no local allocation,
+        no admission, no validation — the primary already did all that),
+        watch events fan out so replica informers stay live, and the
+        record lands in the local WAL for replica durability.
+
+        Records carrying an epoch older than this store's are rejected
+        with a typed 410 (fencing: a zombie primary's late records must
+        not land after a promotion). Records at or below the applied RV
+        are no-ops (reconnect overlap), returning False.
+        """
+        self._race_guard.check()
+        if epoch is not None and epoch < self.epoch:
+            REGISTRY.counter(
+                "repl_fenced_writes_total",
+                "writes refused because this store was fenced by a "
+                "newer replication epoch").inc()
+            raise GoneError(
+                f"replication record from epoch {epoch} rejected: this "
+                f"store is at epoch {self.epoch}")
+        op = rec.get("op")
+        if op == "epoch":
+            e = int(rec["epoch"])
+            if e > self.epoch:
+                self.set_epoch(e)
+            return True
+        rv = int(rec["rv"])
+        if rv <= self._rv:
+            return False
+        key: Key = tuple(rec["key"])  # type: ignore[assignment]
+        if op == "put":
+            old = self._objects.get(key)
+            # ownership transfer: the record dict was parsed off the
+            # feed and is not shared — stored as the snapshot directly
+            obj = self._put_obj(key, rec["obj"])
+            self._rv = rv
+            self._emit(MODIFIED if old is not None else ADDED,
+                       key, obj, rv, old=old)
+            self._log_wal({"op": "put", "key": list(key), "obj": obj,
+                           "rv": rv})
+        elif op == "del":
+            existing = self._objects.get(key)
+            self._del_obj(key)
+            self._rv = rv
+            if existing is not None:
+                self._emit(DELETED, key, existing, rv, old=existing)
+            self._log_wal({"op": "del", "key": list(key), "rv": rv})
+        else:
+            raise InvalidError(f"unknown replication record op {op!r}")
+        return True
+
+    def reset_for_resync(self) -> None:
+        """Drop all local state ahead of a full snapshot resync (the
+        primary's retained ship window no longer covers our applied RV).
+        Open watches close — their consumers re-list, exactly as after a
+        410 — and the caller streams snapshot objects in via
+        :meth:`load_snapshot_object` + :meth:`finish_resync`."""
+        self._flush_events()
+        for w in list(self._watches):
+            w.close()
+        self._objects.clear()
+        self._buckets.clear()
+        self._history.clear()
+        self._pending.clear()
+        self._enc_bytes.clear()
+        self._span_cache.clear()
+        self._bucket_ver.clear()
+        self._rv = 0
+
+    def load_snapshot_object(self, key, obj: dict) -> None:
+        """Insert one snapshot object during a resync (no events, no RV
+        bookkeeping — :meth:`finish_resync` sets the RV watermark)."""
+        self._put_obj(tuple(key), obj)
+
+    def finish_resync(self, rv: int) -> None:
+        """Stamp the snapshot's RV watermark and compact local
+        durability so a replica restart resumes from this point."""
+        self._rv = max(self._rv, int(rv))
+        if self._engine is not None:
+            self._engine.set_rv(self._rv)
+        if self._engine is not None or self._wal is not None:
+            self.snapshot()
+
+    def _apply_wal_record(self, rec: dict) -> None:
+        """Replay one JSON WAL record into the in-memory state."""
+        op = rec.get("op")
+        if op == "epoch":
+            self.epoch = max(self.epoch, int(rec["epoch"]))
+            return
+        key = tuple(rec["key"])
+        if op == "put":
+            self._put_obj(key, rec["obj"])
+        elif op == "del":
+            self._del_obj(key)
+        else:
+            raise ValueError(f"unknown WAL op {op!r}")
+        self._rv = max(self._rv, int(rec.get("rv", 0)))
 
     def _load_wal(self) -> None:
         assert self._wal is not None
@@ -1344,21 +1534,41 @@ class LogicalStore:
             with open(snap, encoding="utf-8") as f:
                 data = json.load(f)
             self._rv = data["rv"]
+            self.epoch = max(self.epoch, int(data.get("epoch", 0)))
             for rec in data["objects"]:
                 self._put_obj(tuple(rec["key"]), rec["obj"])
-        if os.path.exists(self._wal.path):
-            with open(self._wal.path, encoding="utf-8") as f:
-                for line in f:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    rec = json.loads(line)
-                    key = tuple(rec["key"])
-                    if rec["op"] == "put":
-                        self._put_obj(key, rec["obj"])
-                    elif rec["op"] == "del":
-                        self._del_obj(key)
-                    self._rv = max(self._rv, rec.get("rv", 0))
+        if not os.path.exists(self._wal.path):
+            return
+        with open(self._wal.path, "rb") as f:
+            raw = f.read()
+        # torn-tail recovery (the JSON twin of the native engine's CRC
+        # replay): a crash mid-append leaves a partial (or garbled) final
+        # record — replay stops at the first record that fails to parse
+        # and the file is truncated to the last good one, instead of
+        # failing the whole restore and wedging the server on boot.
+        pos = 0
+        end_good = 0
+        while pos < len(raw):
+            nl = raw.find(b"\n", pos)
+            terminated = nl >= 0
+            chunk = raw[pos:nl] if terminated else raw[pos:]
+            nxt = nl + 1 if terminated else len(raw)
+            if chunk.strip():
+                try:
+                    self._apply_wal_record(json.loads(chunk))
+                except (ValueError, KeyError, TypeError) as e:
+                    log.warning(
+                        "WAL %s: torn/corrupt record at byte %d (%s); "
+                        "truncating to last good record (%d bytes dropped)",
+                        self._wal.path, pos, e, len(raw) - end_good)
+                    REGISTRY.counter(
+                        "wal_torn_tail_total",
+                        "WAL restores that dropped a torn/corrupt tail"
+                    ).inc()
+                    os.truncate(self._wal.path, end_good)
+                    return
+            end_good = nxt
+            pos = nxt
 
     def snapshot(self) -> None:
         """Write a snapshot and truncate the WAL (etcd compaction analog)."""
@@ -1377,6 +1587,7 @@ class LogicalStore:
             json.dump(
                 {
                     "rv": self._rv,
+                    "epoch": self.epoch,
                     "objects": [
                         {"key": list(k), "obj": v} for k, v in self._objects.items()
                     ],

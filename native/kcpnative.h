@@ -43,6 +43,13 @@ int ws_get(void* h, const uint8_t* key, uint32_t klen, const uint8_t** val, uint
 uint64_t ws_rv(void* h);
 uint64_t ws_count(void* h);
 int ws_flush(void* h);     // fsync now
+
+// Replication epoch: persisted as an OP_EPOCH WAL record (and re-stamped
+// into every snapshot) so a fence/promotion survives restart. ws_set_rv
+// advances the RV watermark without a mutation record (snapshot resync).
+uint64_t ws_epoch(void* h);
+int ws_set_epoch(void* h, uint64_t epoch);
+void ws_set_rv(void* h, uint64_t rv);
 int ws_snapshot(void* h);  // write snapshot from the engine index, truncate WAL
 
 // Streaming snapshot: the caller supplies the live objects (so the

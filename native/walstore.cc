@@ -38,6 +38,11 @@ using kcpnative::crc32;
 constexpr uint8_t OP_PUT = 1;
 constexpr uint8_t OP_DEL = 2;
 constexpr uint8_t OP_META = 3;
+// replication epoch stamp: the 8-byte little-endian epoch rides the val
+// field; the rv field carries the engine's current rv at stamp time so
+// pre-epoch readers (which treat unknown ops as rv-watermark-only
+// no-ops, like OP_META) replay the record harmlessly.
+constexpr uint8_t OP_EPOCH = 4;
 
 constexpr char MAGIC[8] = {'K', 'C', 'P', 'W', 'A', 'L', '1', '\n'};
 
@@ -47,6 +52,7 @@ struct WalStore {
   int sync_every = 256;
   int unsynced = 0;
   uint64_t rv = 0;
+  uint64_t epoch = 0;
   bool index_enabled = true;
   std::map<std::string, std::string> index;  // ordered: prefix scans
   // streaming snapshot in progress (ws_snapshot_begin/add/commit)
@@ -134,6 +140,10 @@ size_t replay(WalStore* s, const std::string& buf) {
       s->index[key].assign(reinterpret_cast<const char*>(payload) + 17 + klen, vlen);
     } else if (op == OP_DEL) {
       s->index.erase(key);
+    } else if (op == OP_EPOCH && vlen == 8) {
+      uint64_t e;
+      memcpy(&e, payload + 17 + klen, 8);
+      if (e > s->epoch) s->epoch = e;
     }  // OP_META: rv watermark only
     if (rv > s->rv) s->rv = rv;
     off += 8 + len;
@@ -243,6 +253,28 @@ int ws_flush(void* h) {
   return 0;
 }
 
+uint64_t ws_epoch(void* h) { return static_cast<WalStore*>(h)->epoch; }
+
+int ws_set_epoch(void* h, uint64_t epoch) {
+  auto* s = static_cast<WalStore*>(h);
+  uint8_t val[8];
+  memcpy(val, &epoch, 8);
+  if (!append_record(s, encode_payload(OP_EPOCH, s->rv, nullptr, 0, val, 8))) return -1;
+  if (epoch > s->epoch) s->epoch = epoch;
+  // the fence/promotion must be on disk before anything acts on it
+  if (s->fd >= 0 && fsync(s->fd) != 0) {
+    s->fail("fsync");
+    return -1;
+  }
+  s->unsynced = 0;
+  return 0;
+}
+
+void ws_set_rv(void* h, uint64_t rv) {
+  auto* s = static_cast<WalStore*>(h);
+  if (rv > s->rv) s->rv = rv;
+}
+
 }  // extern "C"
 
 namespace {
@@ -321,6 +353,13 @@ int ws_snapshot_begin(void* h) {
   if (s->snap_fd < 0) return -1;
   s->snap_buf.assign(MAGIC, sizeof(MAGIC));
   emit_record(&s->snap_buf, encode_payload(OP_META, s->rv, nullptr, 0, nullptr, 0));
+  if (s->epoch) {
+    // re-stamp the epoch: the snapshot replaces the WAL that carried
+    // the OP_EPOCH record, and a fence must survive compaction
+    uint8_t val[8];
+    memcpy(val, &s->epoch, 8);
+    emit_record(&s->snap_buf, encode_payload(OP_EPOCH, s->rv, nullptr, 0, val, 8));
+  }
   return 0;
 }
 

@@ -190,6 +190,31 @@ print("sharded smoke ok: capacity %sx @2 shards (concurrent %sx on %s cpu)"
          kill["watch_410_ms"], kill["failfast_ms"], kill["acked_writes"]))
 '
 
+echo "== replica: HA replication smoke (read scaling, lag, kill-the-primary drill)"
+# primary + 0/1/2 WAL-fed read replicas, then a durable primary+standby
+# kill drill. Floors: read capacity >=1.5x at 2 replicas (each endpoint
+# measured in its own time slice — honest on 1-core hosts; near-linear
+# is ~3x), list bytes identical to the primary at the same RV (the
+# encode-once differential), and ZERO acknowledged writes lost after
+# the standby promotes.
+repl_line=$(KCP_BENCH_REPL_OBJECTS=500 KCP_BENCH_REPL_SECONDS=0.8 \
+    KCP_BENCH_REPL_LAG_WRITES=60 KCP_BENCH_REPL_DRILL_WRITES=40 \
+    python bench.py --replica | tail -1)
+printf '%s\n' "$repl_line" | python -c '
+import json, sys
+r = json.loads(sys.stdin.readline())
+rb = r["replica_bench"]
+assert rb["bytes_equal"], "replica list bytes diverged from primary at same RV"
+assert r["value"] >= 1.5, "read capacity %sx < 1.5x floor at 2 replicas" % r["value"]
+kill = rb["kill"]
+assert kill["lost_after_promotion"] == 0, "acked writes lost: %s" % kill
+assert kill["promoted_role"] == "primary" and kill["epoch"] >= 1, kill
+print("replica smoke ok: %sx read capacity @2 | lag p99 %sms | kill: %d acked"
+      " / 0 lost, promoted in %sms (epoch %d)"
+      % (r["value"], rb["lag"].get("p99_ms"), kill["acked_writes"],
+         kill["promote_ms"], kill["epoch"]))
+'
+
 if [[ "$fast" == "0" ]]; then
     echo "== demo: both golden scenarios, checked against committed output"
     python contrib/demo/run_demo.py all --check

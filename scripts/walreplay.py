@@ -1,0 +1,231 @@
+#!/usr/bin/env python3
+"""walreplay — deterministic offline WAL replay to a target RV.
+
+The WAL is a total order of every mutation, so replaying it to RV ``N``
+reconstructs the store exactly as it was at that RV — the time-travel
+debugging half of the replication story (the other half, a follower
+replaying to the tip, is ``kcp_tpu/replication/``), and the recovery
+story for quarantine/evacuation forensics: "what did the fleet look
+like right before the bad write?"
+
+Reads both on-disk formats without the server (or the native library):
+
+- the native binary engine (``native/walstore.cc``): ``KCPWAL1\\n`` magic
+  then ``[u32 len][u32 crc32][payload]`` records, payload =
+  ``u8 op | u64 rv | u32 klen | u32 vlen | key | val`` (op 1 put, 2 del,
+  3 meta/rv-watermark, 4 epoch) — parsed in pure Python here, torn
+  tails tolerated exactly like the engine's replay;
+- the JSON-lines fallback (``kcp_tpu/store/store.py``): one record dict
+  per line, plus the ``.snap`` snapshot.
+
+A snapshot compacts history away: replay can only travel back to the
+snapshot's RV watermark (the tool says so rather than guessing).
+
+Usage:
+    python scripts/walreplay.py <root-dir-or-wal-path> [--rv N]
+        [--dump] [--keys] [--json]
+
+    --rv N   stop applying records with rv > N (default: the tip)
+    --dump   print every object (key -> JSON) at the target RV
+    --keys   print just the keys at the target RV
+    --json   machine-readable one-line summary
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import struct
+import sys
+import zlib
+
+MAGIC = b"KCPWAL1\n"
+OP_PUT, OP_DEL, OP_META, OP_EPOCH = 1, 2, 3, 4
+
+
+class ReplayState:
+    def __init__(self) -> None:
+        self.objects: dict[bytes, bytes] = {}
+        self.rv = 0
+        self.epoch = 0
+        self.applied = 0
+        self.skipped_beyond_target = 0
+        self.floor_rv = 0  # snapshot watermark: can't travel before this
+        self.torn_bytes = 0
+
+
+def _iter_native_records(buf: bytes):
+    """Yield (op, rv, key, val, end_offset); stops at the first torn or
+    corrupt record (the engine's truncate-on-replay discipline)."""
+    off = len(MAGIC) if buf.startswith(MAGIC) else 0
+    while off + 8 <= len(buf):
+        length, crc = struct.unpack_from("<II", buf, off)
+        if off + 8 + length > len(buf):
+            return
+        payload = buf[off + 8:off + 8 + length]
+        if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+            return
+        if length < 17:
+            return
+        op = payload[0]
+        rv, klen, vlen = struct.unpack_from("<QII", payload, 1)
+        if 17 + klen + vlen != length:
+            return
+        key = payload[17:17 + klen]
+        val = payload[17 + klen:17 + klen + vlen]
+        off += 8 + length
+        yield op, rv, key, val, off
+
+
+def _replay_native_file(path: str, st: ReplayState, target: int | None,
+                        is_snapshot: bool) -> None:
+    try:
+        with open(path, "rb") as f:
+            buf = f.read()
+    except OSError:
+        return
+    end = len(MAGIC) if buf.startswith(MAGIC) else 0
+    for op, rv, key, val, end in _iter_native_records(buf):
+        if op == OP_EPOCH and len(val) == 8:
+            st.epoch = max(st.epoch, struct.unpack("<Q", val)[0])
+            continue
+        if op == OP_META:
+            # snapshot watermark (or rv stamp): replay cannot travel
+            # below a snapshot's watermark — history before it is gone
+            if is_snapshot:
+                st.floor_rv = max(st.floor_rv, rv)
+            st.rv = max(st.rv, rv)
+            continue
+        if target is not None and not is_snapshot and rv > target:
+            st.skipped_beyond_target += 1
+            continue
+        if op == OP_PUT:
+            st.objects[bytes(key)] = bytes(val)
+        elif op == OP_DEL:
+            st.objects.pop(bytes(key), None)
+        st.rv = max(st.rv, rv)
+        st.applied += 1
+    st.torn_bytes += len(buf) - end
+
+
+def _replay_json(path: str, st: ReplayState, target: int | None) -> None:
+    snap = path + ".snap"
+    if os.path.exists(snap):
+        with open(snap, encoding="utf-8") as f:
+            data = json.load(f)
+        st.floor_rv = max(st.floor_rv, int(data.get("rv", 0)))
+        st.rv = max(st.rv, int(data.get("rv", 0)))
+        st.epoch = max(st.epoch, int(data.get("epoch", 0)))
+        for rec in data.get("objects", []):
+            st.objects["\x00".join(rec["key"]).encode()] = json.dumps(
+                rec["obj"], separators=(",", ":")).encode()
+    if not os.path.exists(path):
+        return
+    with open(path, "rb") as f:
+        raw = f.read()
+    pos = 0
+    while pos < len(raw):
+        nl = raw.find(b"\n", pos)
+        chunk = raw[pos:nl] if nl >= 0 else raw[pos:]
+        nxt = nl + 1 if nl >= 0 else len(raw)
+        if chunk.strip():
+            try:
+                rec = json.loads(chunk)
+                op = rec.get("op")
+            except ValueError:
+                st.torn_bytes += len(raw) - pos
+                return
+            if op == "epoch":
+                st.epoch = max(st.epoch, int(rec.get("epoch", 0)))
+            else:
+                rv = int(rec.get("rv", 0))
+                if target is not None and rv > target:
+                    st.skipped_beyond_target += 1
+                else:
+                    key = "\x00".join(rec["key"]).encode()
+                    if op == "put":
+                        st.objects[key] = json.dumps(
+                            rec["obj"], separators=(",", ":")).encode()
+                    elif op == "del":
+                        st.objects.pop(key, None)
+                    st.rv = max(st.rv, rv)
+                    st.applied += 1
+        pos = nxt
+
+
+def replay(path: str, target: int | None = None) -> ReplayState:
+    """Replay a WAL (auto-detecting format) up to ``target`` RV."""
+    st = ReplayState()
+    head = b""
+    for candidate in (path, path + ".snap"):
+        try:
+            with open(candidate, "rb") as f:
+                head = f.read(len(MAGIC))
+            if head:
+                break
+        except OSError:
+            continue
+    if head == MAGIC or (head and not head.lstrip().startswith(b"{")):
+        # native: the snapshot's records first, then the live WAL tail
+        _replay_native_file(path + ".snap", st, target, is_snapshot=True)
+        _replay_native_file(path, st, target, is_snapshot=False)
+    else:
+        _replay_json(path, st, target)
+    return st
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="deterministic offline WAL replay to a target RV")
+    ap.add_argument("path", help="a server --root-dir or a store.wal path")
+    ap.add_argument("--rv", type=int, default=None,
+                    help="target resourceVersion (default: the tip)")
+    ap.add_argument("--dump", action="store_true",
+                    help="print every object at the target RV")
+    ap.add_argument("--keys", action="store_true",
+                    help="print just the keys at the target RV")
+    ap.add_argument("--json", action="store_true",
+                    help="one-line machine-readable summary")
+    args = ap.parse_args(argv)
+
+    path = args.path
+    if os.path.isdir(path):
+        path = os.path.join(path, "store.wal")
+    if not (os.path.exists(path) or os.path.exists(path + ".snap")):
+        print(f"no WAL at {path}", file=sys.stderr)
+        return 1
+    st = replay(path, args.rv)
+    if args.rv is not None and st.floor_rv > args.rv:
+        print(f"warning: a snapshot compacted history up to rv "
+              f"{st.floor_rv}; the earliest reachable state is rv "
+              f"{st.floor_rv}, not {args.rv}", file=sys.stderr)
+    summary = {
+        "wal": path,
+        "target_rv": args.rv,
+        "rv": st.rv,
+        "epoch": st.epoch,
+        "objects": len(st.objects),
+        "records_applied": st.applied,
+        "records_beyond_target": st.skipped_beyond_target,
+        "snapshot_floor_rv": st.floor_rv,
+        "torn_bytes": st.torn_bytes,
+    }
+    if args.json:
+        print(json.dumps(summary))
+    else:
+        for k, v in summary.items():
+            print(f"{k}: {v}")
+    if args.keys or args.dump:
+        for key in sorted(st.objects):
+            parts = key.decode("utf-8", "replace").split("\x00")
+            if args.dump:
+                print("/".join(parts), st.objects[key].decode("utf-8",
+                                                              "replace"))
+            else:
+                print("/".join(parts))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -786,3 +786,113 @@ def test_flapping_cluster_hysteresis_then_sustained_drain_and_recovery():
         await splitter.stop()
 
     asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# replication injection points (repl.ship / repl.apply / repl.promote)
+# ---------------------------------------------------------------------------
+
+
+def _repl_pair(role="replica", hysteresis=0.4):
+    from kcp_tpu.server.server import Config
+    from kcp_tpu.server.threaded import ServerThread
+
+    p = ServerThread(Config(durable=False, install_controllers=False,
+                            tls=False)).start()
+    f = ServerThread(Config(durable=False, install_controllers=False,
+                            tls=False, role=role, primary=p.address,
+                            repl_hysteresis_s=hysteresis)).start()
+    return p, f
+
+
+def _repl_applied(address: str) -> int:
+    c = RestClient(address)
+    try:
+        return int(c._request("GET", "/replication/status")["applied_rv"])
+    finally:
+        c.close()
+
+
+def test_repl_ship_fault_drill():
+    """`repl.ship:error` kills the feed stream; the follower reconnects
+    and catches up with nothing lost (resume from applied RV)."""
+    faults.install(faults.FaultInjector("repl.ship:error@tick=1", seed=0))
+    p, r = _repl_pair()
+    try:
+        pc = RestClient(p.address, cluster="t1")
+        for i in range(5):
+            pc.create("configmaps", {"apiVersion": "v1", "kind": "ConfigMap",
+                                     "metadata": {"name": f"s{i}",
+                                                  "namespace": "default",
+                                                  "clusterName": "t1"}})
+        assert asyncio.run(wait_until(
+            lambda: _repl_applied(r.address) >= 5, 15.0))
+        assert counter("fault_injected_repl_ship_total") >= 1
+        pc.close()
+    finally:
+        faults.clear()
+        r.stop()
+        p.stop()
+
+
+def test_repl_apply_fault_drill():
+    """`repl.apply:error` drops the feed mid-apply; the reconnect
+    re-resumes from the applied RV, so convergence is exact."""
+    faults.install(faults.FaultInjector("repl.apply:error@tick=2", seed=0))
+    p, r = _repl_pair()
+    try:
+        pc = RestClient(p.address, cluster="t1")
+        for i in range(8):
+            pc.create("configmaps", {"apiVersion": "v1", "kind": "ConfigMap",
+                                     "metadata": {"name": f"a{i}",
+                                                  "namespace": "default",
+                                                  "clusterName": "t1"}})
+        assert asyncio.run(wait_until(
+            lambda: _repl_applied(r.address) >= 8, 15.0))
+        assert counter("fault_injected_repl_apply_total") >= 1
+        rc = RestClient(r.address, cluster="t1")
+        items, rv = rc.list("configmaps", namespace="default")
+        assert rv == 8 and len(items) == 8
+        pc.close()
+        rc.close()
+    finally:
+        faults.clear()
+        r.stop()
+        p.stop()
+
+
+def test_repl_promote_fault_drill():
+    """`repl.promote:error` aborts the first promotion attempt; the
+    standby retries after the next probe cycle and still promotes."""
+    faults.install(faults.FaultInjector("repl.promote:error@tick=1", seed=0))
+    p, s = _repl_pair(role="standby", hysteresis=0.3)
+    try:
+        pc = RestClient(p.address, cluster="t1")
+        pc.create("configmaps", {"apiVersion": "v1", "kind": "ConfigMap",
+                                 "metadata": {"name": "pre",
+                                              "namespace": "default",
+                                              "clusterName": "t1"}})
+        assert asyncio.run(wait_until(
+            lambda: _repl_applied(s.address) >= 1, 15.0))
+        promoted_before = counter("repl_promotions_total")
+        pc.close()
+        p.kill()
+
+        def promoted() -> bool:
+            try:
+                c = RestClient(s.address)
+                try:
+                    st = c._request("GET", "/replication/status")
+                finally:
+                    c.close()
+                return st["role"] == "primary" and st["read_only"] is None
+            except Exception:
+                return False
+
+        assert asyncio.run(wait_until(promoted, 20.0))
+        assert counter("fault_injected_repl_promote_total") >= 1
+        assert counter("repl_promotions_total") == promoted_before + 1
+    finally:
+        faults.clear()
+        s.stop()
+        p.stop()

@@ -1,0 +1,539 @@
+"""HA replication: WAL shipping, RV-honest read replicas, promotion.
+
+Covers: live shipping + replica serving (lists byte-identical to the
+primary at the same RV through the encode-once path), the snapshot
+resync path, RV honesty (a resume beyond the applied RV answers a typed
+410), torn-tail WAL recovery on both durability backends, the offline
+walreplay time-travel tool, and the kill-the-primary chaos drill —
+SIGKILL-equivalent death mid-workload under a KCP_FAULTS schedule,
+standby promotion with zero acknowledged-write loss, zombie fencing,
+and informer catchup. The ``repl.*`` fault-point drills live in
+tests/test_faults.py with the rest of the registry.
+"""
+
+import asyncio
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import time
+from urllib.parse import urlsplit
+
+import pytest
+
+from kcp_tpu import faults
+from kcp_tpu.client import Informer
+from kcp_tpu.server.rest import MultiClusterRestClient, RestClient
+from kcp_tpu.server.server import Config
+from kcp_tpu.server.threaded import ServerThread
+from kcp_tpu.store import LogicalStore
+from kcp_tpu.utils.errors import GoneError, UnavailableError
+from kcp_tpu.utils.trace import REGISTRY
+
+from helpers import wait_until
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    yield
+    faults.clear()
+
+
+def _cm(name: str, cluster: str, data: str = "") -> dict:
+    return {"apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": name, "namespace": "default",
+                         "clusterName": cluster},
+            "data": {"v": data}}
+
+
+def _server(role: str = "shard", primary: str = "", root_dir: str = "",
+            hysteresis: float = 0.4) -> ServerThread:
+    kw: dict = dict(durable=bool(root_dir), install_controllers=False,
+                    tls=False, role=role)
+    if root_dir:
+        kw["root_dir"] = root_dir
+    if primary:
+        kw["primary"] = primary
+        kw["repl_hysteresis_s"] = hysteresis
+    return ServerThread(Config(**kw)).start()
+
+
+def _applied_rv(address: str) -> int:
+    c = RestClient(address)
+    try:
+        return int(c._request("GET", "/replication/status")["applied_rv"])
+    finally:
+        c.close()
+
+
+def _repl_status(address: str) -> dict:
+    c = RestClient(address)
+    try:
+        return c._request("GET", "/replication/status")
+    finally:
+        c.close()
+
+
+def _wait_applied(address: str, rv: int, timeout: float = 15.0) -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            if _applied_rv(address) >= rv:
+                return
+        except Exception:
+            pass
+        time.sleep(0.05)
+    raise AssertionError(
+        f"{address} never applied rv {rv} (at {_applied_rv(address)})")
+
+
+def _raw_get(address: str, target: str) -> tuple[int, bytes]:
+    c = RestClient(address)
+    try:
+        status, _h, body = c.request_raw("GET", target)
+        return status, body
+    finally:
+        c.close()
+
+
+# ---------------------------------------------------------------------------
+# live shipping + RV-honest serving
+# ---------------------------------------------------------------------------
+
+
+def test_replica_ships_serves_and_stays_rv_honest():
+    p = _server()
+    r = _server(role="replica", primary=p.address)
+    try:
+        pc = RestClient(p.address, cluster="t1")
+        for i in range(12):
+            pc.create("configmaps", _cm(f"cm{i}", "t1", str(i)))
+        pc.update("configmaps", {**_cm("cm0", "t1", "updated"),
+                                 "metadata": {"name": "cm0",
+                                              "namespace": "default",
+                                              "clusterName": "t1"}})
+        pc.delete("configmaps", "cm11", "default")
+        _wait_applied(r.address, 14)
+
+        rc = RestClient(r.address, cluster="t1")
+        items, rv = rc.list("configmaps", namespace="default")
+        assert rv == 14 and len(items) == 11
+        assert {o["metadata"]["name"] for o in items} == {
+            f"cm{i}" for i in range(11)}
+        # the replica reports ITS OWN applied RV, never the primary's
+        st = _repl_status(r.address)
+        assert st["role"] == "replica" and st["applied_rv"] == 14
+
+        # writes are refused with a routing-grade 503
+        with pytest.raises(UnavailableError):
+            rc.create("configmaps", _cm("nope", "t1"))
+
+        # RV honesty: resuming beyond the applied RV is a typed 410
+        w = rc.watch("configmaps", since_rv=10_000)
+
+        async def drain():
+            async for _ in w:
+                pass
+
+        with pytest.raises(GoneError):
+            asyncio.run(drain())
+        # an honest resume inside the window replays normally
+        w2 = rc.watch("configmaps", since_rv=12)
+
+        async def take():
+            out = []
+            async for ev in w2:
+                out.append(ev)
+                if len(out) == 2:
+                    break
+            return out
+
+        evs = asyncio.run(take())
+        # the DELETED wire event carries the object's last-written RV
+        # (12, its create), exactly as the primary's own wire does
+        assert [(e.type, e.rv) for e in evs] == [("MODIFIED", 13),
+                                                ("DELETED", 12)]
+        pc.close()
+        rc.close()
+    finally:
+        r.stop()
+        p.stop()
+
+
+def test_replica_lists_byte_identical_to_primary_at_same_rv():
+    """The differential check the ISSUE gates on: at the same RV, a
+    replica's list bytes are the primary's list bytes — both serve
+    through their own encode-once caches, and the shipped snapshots
+    round-trip to identical JSON."""
+    p = _server()
+    r = _server(role="replica", primary=p.address)
+    try:
+        import random
+
+        rng = random.Random(20260804)
+        pc = MultiClusterRestClient(p.address)
+        clusters = ["ca", "cb", "cc"]
+        live: dict[str, set] = {c: set() for c in clusters}
+        rv = 0
+        for step in range(120):
+            c = rng.choice(clusters)
+            roll = rng.random()
+            if live[c] and roll < 0.2:
+                name = rng.choice(sorted(live[c]))
+                pc.delete("configmaps", name, "default", cluster=c)
+                live[c].discard(name)
+            elif live[c] and roll < 0.5:
+                name = rng.choice(sorted(live[c]))
+                got = pc.cluster_client(c).get("configmaps", name, "default")
+                got["data"] = {"v": f"u{step}"}
+                pc.update("configmaps", got)
+            else:
+                name = f"cm-{c}-{step}"
+                pc.create("configmaps", _cm(name, c, str(step)))
+                live[c].add(name)
+        rv = int(_repl_status(p.address)["applied_rv"])
+        _wait_applied(r.address, rv)
+
+        targets = ["/clusters/*/api/v1/configmaps"]
+        targets += [f"/clusters/{c}/api/v1/namespaces/default/configmaps"
+                    for c in clusters]
+        targets += [f"/clusters/{clusters[0]}/api/v1/namespaces/default/"
+                    f"configmaps/{name}"
+                    for name in sorted(live[clusters[0]])[:3]]
+        for t in targets:
+            ps, pb = _raw_get(p.address, t)
+            rs, rb = _raw_get(r.address, t)
+            assert (ps, pb) == (rs, rb), f"diverged on {t}"
+        pc.close()
+    finally:
+        r.stop()
+        p.stop()
+
+
+def test_full_snapshot_resync_when_window_expired():
+    """A follower whose RV predates the hub's retained record window
+    gets a consistent full snapshot + barrier instead of a broken tail."""
+    p = _server()
+    try:
+        pc = RestClient(p.address, cluster="t1")
+        for i in range(30):
+            pc.create("configmaps", _cm(f"cm{i}", "t1"))
+        pc.delete("configmaps", "cm7", "default")
+        # expire the window: a fresh follower (rv 0) must snapshot
+        p.call(lambda: p.server.repl_hub._records.clear())
+        r = _server(role="replica", primary=p.address)
+        try:
+            _wait_applied(r.address, 31)
+            rc = RestClient(r.address, cluster="t1")
+            items, rv = rc.list("configmaps", namespace="default")
+            assert rv == 31 and len(items) == 29
+            # and live records keep flowing after the snapshot
+            pc.create("configmaps", _cm("after-snap", "t1"))
+            _wait_applied(r.address, 32)
+            assert rc.get("configmaps", "after-snap", "default")
+            rc.close()
+        finally:
+            r.stop()
+        pc.close()
+    finally:
+        p.stop()
+
+
+# ---------------------------------------------------------------------------
+# torn-tail WAL recovery (both backends)
+# ---------------------------------------------------------------------------
+
+
+def test_json_wal_torn_tail_truncates_and_recovers(tmp_path):
+    wal = str(tmp_path / "store.wal")
+    s = LogicalStore(wal_path=wal, wal_backend="json")
+    for i in range(5):
+        s.create("configmaps", "c", {"metadata": {"name": f"x{i}"}})
+    s.close()
+    with open(wal, "ab") as f:  # a crash mid-append: half a record
+        f.write(b'{"op":"put","key":["configmaps","c","","torn"],"obj":{"metadata":{"na')
+    before = REGISTRY.counter("wal_torn_tail_total").value
+    s2 = LogicalStore(wal_path=wal, wal_backend="json")
+    assert len(s2) == 5 and s2.resource_version == 5
+    assert REGISTRY.counter("wal_torn_tail_total").value == before + 1
+    # the tail is gone from disk and appends continue cleanly
+    s2.create("configmaps", "c", {"metadata": {"name": "x5"}})
+    s2.close()
+    s3 = LogicalStore(wal_path=wal, wal_backend="json")
+    assert len(s3) == 6 and s3.resource_version == 6
+    s3.close()
+
+
+def test_json_wal_corrupt_mid_record_stops_at_last_good(tmp_path):
+    wal = str(tmp_path / "store.wal")
+    s = LogicalStore(wal_path=wal, wal_backend="json")
+    for i in range(3):
+        s.create("configmaps", "c", {"metadata": {"name": f"x{i}"}})
+    s.close()
+    raw = open(wal, "rb").read()
+    lines = raw.splitlines(keepends=True)
+    # corrupt the SECOND record: replay keeps only the first
+    lines[1] = lines[1][: len(lines[1]) // 2] + b"\n"
+    with open(wal, "wb") as f:
+        f.writelines(lines)
+    s2 = LogicalStore(wal_path=wal, wal_backend="json")
+    assert len(s2) == 1 and s2.resource_version == 1
+    s2.close()
+
+
+def test_native_wal_torn_tail_truncates_and_recovers(tmp_path):
+    from kcp_tpu.native import available
+
+    if not available():
+        pytest.skip("native library unavailable")
+    wal = str(tmp_path / "store.wal")
+    s = LogicalStore(wal_path=wal, wal_backend="native")
+    for i in range(5):
+        s.create("configmaps", "c", {"metadata": {"name": f"x{i}"}})
+    s.close()
+    with open(wal, "ab") as f:  # torn record: length prefix + garbage
+        f.write(b"\xff\x00\x00\x00GARBAGE")
+    s2 = LogicalStore(wal_path=wal, wal_backend="native")
+    assert len(s2) == 5 and s2.resource_version == 5
+    s2.create("configmaps", "c", {"metadata": {"name": "x5"}})
+    s2.close()
+    s3 = LogicalStore(wal_path=wal, wal_backend="native")
+    assert len(s3) == 6 and s3.resource_version == 6
+    s3.close()
+
+
+# ---------------------------------------------------------------------------
+# epoch persistence + walreplay time travel
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("backend", ["json", "native"])
+def test_epoch_persists_across_restart_and_snapshot(tmp_path, backend):
+    if backend == "native":
+        from kcp_tpu.native import available
+
+        if not available():
+            pytest.skip("native library unavailable")
+    wal = str(tmp_path / "store.wal")
+    s = LogicalStore(wal_path=wal, wal_backend=backend)
+    s.create("configmaps", "c", {"metadata": {"name": "x"}})
+    s.set_epoch(3)
+    s.snapshot()  # epoch must survive compaction
+    s.create("configmaps", "c", {"metadata": {"name": "y"}})
+    s.close()
+    s2 = LogicalStore(wal_path=wal, wal_backend=backend)
+    assert s2.epoch == 3 and len(s2) == 2
+    with pytest.raises(Exception):
+        s2.set_epoch(2)  # epochs never rewind
+    s2.close()
+
+
+@pytest.mark.parametrize("backend", ["json", "native"])
+def test_walreplay_time_travel(tmp_path, backend):
+    if backend == "native":
+        from kcp_tpu.native import available
+
+        if not available():
+            pytest.skip("native library unavailable")
+    wal = str(tmp_path / "store.wal")
+    s = LogicalStore(wal_path=wal, wal_backend=backend)
+    for i in range(8):
+        s.create("configmaps", "c", {"metadata": {"name": f"x{i}"}})
+    s.delete("configmaps", "c", "x0")
+    s.close()
+    script = os.path.join(os.path.dirname(__file__), "..", "scripts",
+                          "walreplay.py")
+
+    def run(*args):
+        out = subprocess.run([sys.executable, script, *args],
+                             capture_output=True, text=True, check=True)
+        return json.loads(out.stdout.splitlines()[0])
+
+    tip = run(str(tmp_path), "--json")
+    assert tip["rv"] == 9 and tip["objects"] == 7
+    back = run(wal, "--rv", "4", "--json")
+    assert back["rv"] == 4 and back["objects"] == 4
+    assert back["records_beyond_target"] == 5
+
+
+# ---------------------------------------------------------------------------
+# kill-the-primary: promotion, zero acked loss, fencing, informer catchup
+# ---------------------------------------------------------------------------
+
+
+def test_kill_the_primary_drill(tmp_path):
+    """The ISSUE's acceptance drill: SIGKILL-equivalent primary death
+    mid-workload under a KCP_FAULTS schedule. Every acknowledged write
+    survives on the promoted standby (semi-sync shipping makes that a
+    property, not a race), the standby starts taking writes, an
+    informer bound to the standby sees the whole history without a
+    relist, and the revived zombie primary is fenced — it cannot
+    commit."""
+    primary = _server(root_dir=str(tmp_path / "p"))
+    standby = _server(role="standby", primary=primary.address,
+                      root_dir=str(tmp_path / "s"), hysteresis=0.4)
+    try:
+        # standby attached (it acks => semi-sync commits are on)
+        assert wait_until_sync(primary)
+
+        faults.install(faults.FaultInjector(
+            "repl.ship:latency=2ms;store.put:error=0.03", seed=1337))
+
+        async def main():
+            inf = Informer(MultiClusterRestClient(standby.address),
+                           "configmaps")
+            await inf.start()
+
+            acked: list[str] = []
+            killed = asyncio.Event()
+
+            def writer():
+                pc = MultiClusterRestClient(primary.address)
+                sc = MultiClusterRestClient(standby.address)
+                try:
+                    for i in range(60):
+                        name = f"cm{i}"
+                        if i == 30:
+                            primary.kill()
+                            killed.set()
+                        deadline = time.time() + 30
+                        while True:
+                            client = sc if killed.is_set() else pc
+                            try:
+                                client.create("configmaps",
+                                              _cm(name, "t1", str(i)))
+                                acked.append(name)
+                                break
+                            except Exception as e:
+                                from kcp_tpu.utils import errors as kerr
+
+                                if isinstance(e, kerr.AlreadyExistsError):
+                                    # the ack was lost, not the write
+                                    acked.append(name)
+                                    break
+                                if time.time() > deadline:
+                                    raise
+                                time.sleep(0.05)
+                finally:
+                    pc.close()
+                    sc.close()
+                return acked
+
+            await asyncio.get_running_loop().run_in_executor(None, writer)
+            faults.clear()
+
+            # the standby promoted and serves writes
+            st = _repl_status(standby.address)
+            assert st["role"] == "primary" and st["read_only"] is None
+            assert st["epoch"] == 1
+            assert REGISTRY.counter("repl_promotions_total").value >= 1
+
+            # ZERO acknowledged-write loss
+            sc = MultiClusterRestClient(standby.address)
+            items, _rv = sc.list("configmaps", namespace="default")
+            names = {o["metadata"]["name"] for o in items}
+            lost = [n for n in acked if n not in names]
+            assert not lost, f"acked writes lost after promotion: {lost}"
+            assert len(acked) == 60
+
+            # the informer rode the standby through the whole failover
+            def caught_up() -> bool:
+                return {o["metadata"]["name"]
+                        for o in inf.list()} >= set(acked)
+
+            from helpers import wait_until
+
+            assert await wait_until(caught_up, timeout=15.0), (
+                "informer did not catch up after promotion")
+            await inf.stop()
+            sc.close()
+
+        asyncio.run(main())
+
+        # revive the zombie on its old address: the promoted standby's
+        # fence task finds it and it must refuse to commit
+        port = urlsplit(primary.address).port
+        cfg = dataclasses.replace(primary.server.config, listen_port=port)
+        zombie = None
+        for _ in range(10):
+            try:
+                zombie = ServerThread(cfg).start()
+                break
+            except RuntimeError:
+                time.sleep(0.2)
+        assert zombie is not None, "could not revive the zombie primary"
+        try:
+            def fenced() -> bool:
+                try:
+                    return _repl_status(zombie.address)["fenced"]
+                except Exception:
+                    return False
+
+            deadline = time.time() + 15
+            while time.time() < deadline and not fenced():
+                time.sleep(0.2)
+            assert fenced(), "zombie primary never got fenced"
+            st = _repl_status(zombie.address)
+            assert st["epoch"] == 1
+            before = REGISTRY.counter("repl_fenced_writes_total").value
+            zc = MultiClusterRestClient(zombie.address)
+            with pytest.raises(UnavailableError):
+                zc.create("configmaps", _cm("zombie-write", "t1"))
+            zc.close()
+            assert REGISTRY.counter(
+                "repl_fenced_writes_total").value > before
+        finally:
+            zombie.stop()
+    finally:
+        standby.stop()
+        primary.stop()
+
+
+def wait_until_sync(primary: ServerThread, timeout: float = 10.0) -> bool:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if primary.call(
+                lambda: primary.server.repl_hub.has_sync_subscribers):
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_differential_fuzz_under_repl_chaos():
+    """Replica-vs-primary equivalence under an active KCP_FAULTS
+    schedule (ship stream deaths + apply faults + watch drops): the
+    feed reconnects and re-resumes, and once the schedule clears the
+    replica's state converges byte-identically."""
+    p = _server()
+    r = _server(role="replica", primary=p.address)
+    try:
+        faults.install(faults.FaultInjector(
+            "repl.ship:error=0.1;repl.apply:error=0.05;watch:drop=0.05",
+            seed=7))
+        import random
+
+        rng = random.Random(7)
+        pc = MultiClusterRestClient(p.address)
+        live: set[str] = set()
+        for step in range(100):
+            if live and rng.random() < 0.3:
+                name = rng.choice(sorted(live))
+                pc.delete("configmaps", name, "default", cluster="t1")
+                live.discard(name)
+            else:
+                name = f"f{step}"
+                pc.create("configmaps", _cm(name, "t1", str(step)))
+                live.add(name)
+        faults.clear()
+        rv = int(_repl_status(p.address)["applied_rv"])
+        _wait_applied(r.address, rv, timeout=20.0)
+        t = "/clusters/t1/api/v1/namespaces/default/configmaps"
+        ps, pb = _raw_get(p.address, t)
+        rs, rb = _raw_get(r.address, t)
+        assert (ps, pb) == (rs, rb)
+        assert json.loads(pb)["metadata"]["resourceVersion"] == str(rv)
+        pc.close()
+    finally:
+        r.stop()
+        p.stop()
