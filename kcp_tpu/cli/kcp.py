@@ -84,7 +84,21 @@ def build_parser():
     start.add_argument("--primary", default="",
                        help="replica/standby roles: the primary server's "
                             "base URL (the /replication/wal feed source "
-                            "and promotion health-probe target)")
+                            "and promotion health-probe target). A "
+                            "replica accepts a comma-separated candidate "
+                            "list (url1,url2): when its primary stays "
+                            "dead or fenced past the hysteresis window "
+                            "it probes the candidates in order and "
+                            "re-homes onto the live promoted primary. "
+                            "Env KCP_PRIMARY is the fallback")
+    start.add_argument("--drain-timeout", type=float, default=None,
+                       help="graceful-drain budget in seconds on SIGTERM "
+                            "(env KCP_DRAIN_TIMEOUT_S, default 5.0): "
+                            "stop accepting, finish in-flight requests, "
+                            "send terminal Status to watchers, flush "
+                            "replication subscribers, then exit; "
+                            "whatever is still alive at the deadline is "
+                            "cut off hard. SIGINT skips the drain")
     start.add_argument("--repl-hysteresis", type=float, default=None,
                        help="standby promotion hysteresis seconds (env "
                             "KCP_REPL_HYSTERESIS_S, default 3.0): how long "
@@ -178,6 +192,7 @@ def config_from_args(args) -> Config:
         primary=args.primary,
         repl_hysteresis_s=args.repl_hysteresis,
         repl_lag_max=args.repl_lag_max,
+        drain_timeout_s=args.drain_timeout,
         poll_interval=args.poll_interval,
         import_poll_interval=args.poll_interval,
         authz=args.authz,
@@ -198,11 +213,33 @@ async def serve(config: Config) -> None:
 
     server.add_post_start_hook(announce)
     loop = asyncio.get_event_loop()
-    for sig in (signal.SIGINT, signal.SIGTERM):
-        try:
-            loop.add_signal_handler(sig, server.stop)
-        except NotImplementedError:  # non-unix
-            pass
+
+    draining = False
+
+    def _graceful() -> None:
+        # SIGTERM: drain first (stop accepting, finish in-flight, send
+        # terminal Status to watchers, flush replication), THEN stop. A
+        # second SIGTERM — or a drain abort — falls through to the
+        # immediate stop.
+        nonlocal draining
+        if draining:
+            server.stop()
+            return
+        draining = True
+
+        async def _drain_then_stop() -> None:
+            try:
+                await server.drain()
+            finally:
+                server.stop()
+
+        asyncio.ensure_future(_drain_then_stop())
+
+    try:
+        loop.add_signal_handler(signal.SIGINT, server.stop)
+        loop.add_signal_handler(signal.SIGTERM, _graceful)
+    except NotImplementedError:  # non-unix
+        pass
     await server.run()
 
 

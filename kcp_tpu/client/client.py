@@ -116,8 +116,12 @@ class MultiClusterClient(Client):
     ``metadata.clusterName``.
     """
 
-    def __init__(self, store: LogicalStore):
-        super().__init__(store, WILDCARD)
+    def __init__(self, store: LogicalStore, scheme: Scheme | None = None):
+        # accepts the SERVER's scheme so in-process controllers (CRD
+        # lifecycle, negotiation) register dynamic resources into the
+        # same registry the REST handler serves from — without it, a CRD
+        # created over REST never becomes servable over REST
+        super().__init__(store, WILDCARD, scheme)
 
     def cluster_client(self, cluster: str) -> Client:
         # share the scheme: CRD registrations must be visible to every view

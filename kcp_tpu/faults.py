@@ -62,6 +62,15 @@ Injection points wired in this codebase:
                                  promotion (error = the promotion
                                  attempt aborts and retries after the
                                  next probe cycle)
+    server.drain                 server/server.py graceful drain (error
+                                 = the drain aborts and the shutdown
+                                 escalates to an immediate hard stop,
+                                 latency = a slow drain)
+    scenario.phase               scenarios/engine.py phase boundary
+                                 (latency = a stalled phase transition,
+                                 error = the scenario run aborts — the
+                                 harness's own failure path is drilled
+                                 like everything else)
 
 Sites call the module-level helpers, which are near-free no-ops when no
 injector is active (one global read).
@@ -108,6 +117,8 @@ POINTS = frozenset({
     "repl.ship",
     "repl.apply",
     "repl.promote",
+    "server.drain",
+    "scenario.phase",
 })
 
 

@@ -78,18 +78,20 @@ class ReplicationApplier:
         if role not in ("replica", "standby"):
             raise ValueError(f"unknown replication role {role!r}")
         self.store = store
-        self.primary_url = primary_url.rstrip("/")
+        # ``primary_url`` may be a comma-separated CANDIDATE list
+        # (url1,url2 — the KCP_PRIMARY form): the first entry is the
+        # configured primary; a replica whose primary stays dead or
+        # fenced past the hysteresis window probes the candidates in
+        # order and re-homes onto whichever one answers as the live
+        # primary (the promoted standby after a failover)
+        self.candidates = [u.strip().rstrip("/")
+                           for u in primary_url.split(",") if u.strip()]
+        if not self.candidates:
+            raise ValueError("replication applier needs a primary URL")
         self.role = role
         self.token = token
-        parts = urlsplit(self.primary_url)
-        self._host = parts.hostname or "127.0.0.1"
-        self._tls = parts.scheme == "https"
-        self._port = parts.port or (443 if self._tls else 80)
-        self._ssl = None
-        if self._tls:
-            from ..server.certs import client_context
-
-            self._ssl = client_context(ca_data, ca_file)
+        self._ca_data = ca_data
+        self._ca_file = ca_file
         self.hysteresis_s = hysteresis_s
         self.probe_interval_s = probe_interval_s
         self.on_promote = on_promote
@@ -101,11 +103,11 @@ class ReplicationApplier:
         self._task: asyncio.Task | None = None
         self._fence_task: asyncio.Task | None = None
         self._stopped = False
-        # the primary-death detector: transport probes through a breaker,
-        # exactly like any other dead-peer detection in this codebase
-        self.breaker = CircuitBreaker(
-            f"repl_primary_{self._host}_{self._port}", failure_threshold=3,
-            reset_timeout=probe_interval_s)
+        self._set_primary(self.candidates[0])
+        self._rehomes = REGISTRY.counter(
+            "repl_rehome_total",
+            "times a follower re-resolved its feed onto another primary "
+            "candidate (the promoted standby after a failover)")
         self._applied_gauge = REGISTRY.gauge(
             "repl_applied_rv",
             "highest primary RV this follower has applied")
@@ -116,6 +118,26 @@ class ReplicationApplier:
         self._applied_total = REGISTRY.counter(
             "repl_apply_records_total",
             "WAL records applied from the replication feed")
+
+    def _set_primary(self, url: str) -> None:
+        """Point the feed/probe/ack/fence plumbing at ``url`` (the
+        initial primary, or a re-homed candidate) with a fresh breaker —
+        the new primary must not inherit the dead one's open circuit."""
+        self.primary_url = url
+        parts = urlsplit(url)
+        self._host = parts.hostname or "127.0.0.1"
+        self._tls = parts.scheme == "https"
+        self._port = parts.port or (443 if self._tls else 80)
+        self._ssl = None
+        if self._tls:
+            from ..server.certs import client_context
+
+            self._ssl = client_context(self._ca_data, self._ca_file)
+        # the primary-death detector: transport probes through a breaker,
+        # exactly like any other dead-peer detection in this codebase
+        self.breaker = CircuitBreaker(
+            f"repl_primary_{self._host}_{self._port}", failure_threshold=3,
+            reset_timeout=self.probe_interval_s)
 
     # ------------------------------------------------------------ public
 
@@ -158,7 +180,15 @@ class ReplicationApplier:
                 return
             if streamed:
                 down_since = None  # we WERE connected; restart the clock
-            healthy = await loop.run_in_executor(None, self._probe_blocking)
+            info = await loop.run_in_executor(None, self._probe_blocking,
+                                              None)
+            # a reachable primary is healthy for a standby (promotion is
+            # about primary DEATH); a replica additionally treats a
+            # FENCED primary as gone — its feed can never commit again,
+            # so the re-home clock runs even though the process answers
+            healthy = info is not None
+            if healthy and self.role == "replica" and info.get("fenced"):
+                healthy = False
             if healthy:
                 self.breaker.record_success()
                 down_since = None
@@ -168,40 +198,90 @@ class ReplicationApplier:
                     down_since = loop.time()
                 from ..utils.circuit import OPEN
 
-                if (self.role == "standby"
-                        and self.breaker.state == OPEN
+                if (self.breaker.state == OPEN
                         and loop.time() - down_since >= self.hysteresis_s):
-                    try:
-                        await self._promote()
-                        return
-                    except asyncio.CancelledError:
-                        raise
-                    except Exception as e:
-                        # injected repl.promote fault (or a transient
-                        # persistence failure): retry next cycle — the
-                        # hysteresis clock keeps running
-                        log.warning("promotion attempt aborted: %s", e)
+                    if self.role == "standby":
+                        try:
+                            await self._promote()
+                            return
+                        except asyncio.CancelledError:
+                            raise
+                        except Exception as e:
+                            # injected repl.promote fault (or a transient
+                            # persistence failure): retry next cycle — the
+                            # hysteresis clock keeps running
+                            log.warning("promotion attempt aborted: %s", e)
+                    elif len(self.candidates) > 1:
+                        # replica re-homing: the configured primary is
+                        # dead or fenced past hysteresis — probe the
+                        # candidate list for the promoted primary and
+                        # follow the live epoch
+                        if await loop.run_in_executor(
+                                None, self._rehome_blocking):
+                            down_since = None
             await asyncio.sleep(self.probe_interval_s)
 
-    def _probe_blocking(self) -> bool:
-        """One short-timeout /healthz probe (executor thread)."""
+    def _probe_blocking(self, url: str | None = None) -> dict | None:
+        """One short-timeout ``/replication/status`` probe (executor
+        thread) — the liveness AND role/epoch/fence oracle; None when
+        unreachable. ``url`` overrides the current primary (candidate
+        probes during re-homing)."""
+        if url is None:
+            host, port = self._host, self._port
+            tls, ssl_ctx = self._tls, self._ssl
+        else:
+            parts = urlsplit(url)
+            host = parts.hostname or "127.0.0.1"
+            tls = parts.scheme == "https"
+            port = parts.port or (443 if tls else 80)
+            ssl_ctx = None
+            if tls:
+                from ..server.certs import client_context
+
+                ssl_ctx = client_context(self._ca_data, self._ca_file)
         conn = None
         try:
-            if self._tls:
+            if tls:
                 conn = http.client.HTTPSConnection(
-                    self._host, self._port, timeout=1.0, context=self._ssl)
+                    host, port, timeout=1.0, context=ssl_ctx)
             else:
-                conn = http.client.HTTPConnection(
-                    self._host, self._port, timeout=1.0)
-            conn.request("GET", "/healthz")
+                conn = http.client.HTTPConnection(host, port, timeout=1.0)
+            conn.request("GET", "/replication/status")
             resp = conn.getresponse()
-            resp.read()
-            return resp.status == 200
-        except (ConnectionError, OSError, http.client.HTTPException):
-            return False
+            body = resp.read()
+            if resp.status != 200:
+                return None
+            out = json.loads(body)
+            return out if isinstance(out, dict) else None
+        except (ConnectionError, OSError, http.client.HTTPException,
+                ValueError):
+            return None
         finally:
             if conn is not None:
                 conn.close()
+
+    def _rehome_blocking(self) -> bool:
+        """Probe the candidate list in order and adopt the first live,
+        unfenced PRIMARY at our epoch or newer (the promoted standby
+        after a failover; an older epoch is a zombie). Runs on the
+        executor thread the probe loop already awaits, so the feed task
+        never observes a half-switched primary. True when re-pointed."""
+        for url in self.candidates:
+            if url == self.primary_url:
+                continue
+            info = self._probe_blocking(url)
+            if info is None or info.get("fenced"):
+                continue
+            if info.get("role") != "primary":
+                continue  # an unpromoted standby cannot feed us writes yet
+            if int(info.get("epoch", 0) or 0) < self.store.epoch:
+                continue  # a fenced-epoch zombie answering before its fence
+            log.warning("re-homing replication feed: %s -> %s (epoch %s)",
+                        self.primary_url, url, info.get("epoch"))
+            self._set_primary(url)
+            self._rehomes.inc()
+            return True
+        return False
 
     async def _follow_once(self) -> bool:
         """One feed connection: catch up, then apply live records until

@@ -228,9 +228,33 @@ class ReplicationHub:
                 batch = [line]
                 while not sub.q.empty():
                     batch.append(sub.q.get_nowait())
+                # graceful drain: the b"" sentinel (hub.drain) arrives
+                # AFTER every shipped record in FIFO order — flush what
+                # precedes it, answer a terminal Status, and end the feed
+                # so the follower reconnects against whoever serves next
+                draining = b"" in batch
+                if draining:
+                    batch = [ln for ln in batch if ln]
                 delay = maybe_fail("repl.ship")
                 if delay:
                     await asyncio.sleep(delay)
-                await stream.send_raw_many(batch)
+                if batch:
+                    await stream.send_raw_many(batch)
+                if draining:
+                    await stream.send_json({"type": "ERROR", "object": {
+                        "kind": "Status", "apiVersion": "v1",
+                        "status": "Failure",
+                        "reason": "ServiceUnavailable", "code": 503,
+                        "message": "primary is draining; re-resolve and "
+                                   "resume from your applied RV"}})
+                    return
         finally:
             self._unregister(sub)
+
+    def drain(self) -> None:
+        """Graceful drain: every subscriber feed flushes its queued
+        records and then terminates with an in-stream Status. Runs on
+        the store's owning loop AFTER the last write committed, so the
+        sentinel is ordered behind every shipped record."""
+        for sub in list(self._subs.values()):
+            sub.q.put_nowait(b"")

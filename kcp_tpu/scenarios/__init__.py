@@ -1,0 +1,19 @@
+"""Scenario harness: seeded end-to-end chaos scenarios with SLO
+scorecards (ROADMAP item 5 — the acceptance harness for the sharded /
+replicated / fleet-batched stack).
+
+- :mod:`.spec` — declarative scenario model (phases × tenant mixes ×
+  fault schedules × SLOs);
+- :mod:`.topology` — real-server constellations (monolith, shard fleet
+  behind the router, primary+standby+replica);
+- :mod:`.workload` — seeded replayable op schedules, writer ledgers,
+  watch-stream observers with honest loss accounting;
+- :mod:`.engine` — the run loop + scorecard;
+- :mod:`.catalog` — the named scenarios ``scripts/scenarios.py`` runs.
+"""
+
+from .catalog import SCENARIOS
+from .engine import run_scenario
+from .spec import SLO, Phase, ScenarioSpec
+
+__all__ = ["SCENARIOS", "run_scenario", "SLO", "Phase", "ScenarioSpec"]

@@ -1,0 +1,167 @@
+"""The named scenarios: the repo's end-to-end acceptance suite.
+
+Each entry composes subsystems PRs 1–9 shipped individually — CRUD
+serving + encode-once lists, watch fan-out + informer discipline,
+admission/flow control, the shard router, WAL replication + promotion,
+graceful drain — into one declared-SLO workload. ``scripts/scenarios.py
+run --all --seed N`` runs them all and emits one JSON scorecard;
+``scripts/ci.sh`` gates a reduced-scale subset.
+
+SLO targets are deliberately scale-independent (ScenarioSpec.scaled
+never touches them): an objective that only holds at toy scale is not
+an objective. Latency bounds leave headroom for loaded CI hosts —
+regressions they exist to catch (lost events, lost writes, unthrottled
+floods, silent stream deaths) are step functions, not millisecond
+drift.
+"""
+
+from __future__ import annotations
+
+from .spec import SLO, Phase, ScenarioSpec
+
+CRUD_CHURN = ScenarioSpec(
+    name="crud-churn",
+    description="N-tenant CRUD churn under a watcher fleet: the "
+                "bread-and-butter lane — every ack converges to every "
+                "stream, nothing is lost, nothing 5xxes.",
+    topology="monolith",
+    tenants=8,
+    watchers_per_tenant=2,
+    phases=(Phase("warm", ops_per_tenant=20),
+            Phase("churn", ops_per_tenant=60)),
+    slos=(
+        SLO("convergence", "p99_convergence_ms", "<=", 400.0),
+        SLO("no-lost-acked-writes", "lost_acked_writes", "==", 0),
+        SLO("no-lost-watch-events", "lost_watch_events", "==", 0),
+        SLO("no-unclean-stream-deaths", "unclean_stream_ends", "==", 0),
+        SLO("error-budget-5xx", "http_5xx", "==", 0),
+    ),
+)
+
+NOISY_NEIGHBOR = ScenarioSpec(
+    name="noisy-neighbor",
+    description="One tenant floods writes at many times its token rate "
+                "while quiet tenants keep working: flow control must "
+                "throttle the flood (429 + Retry-After) and keep the "
+                "quiet tenants' p99 within a declared ratio of their "
+                "no-storm baseline.",
+    topology="monolith",
+    tenants=6,
+    watchers_per_tenant=1,
+    env={"KCP_FLOW_RATE": "80", "KCP_FLOW_BURST": "40"},
+    phases=(Phase("baseline", ops_per_tenant=40),
+            Phase("storm", ops_per_tenant=40, action="flood")),
+    options={"flood_ops": 600, "pace_s": 0.02},
+    slos=(
+        SLO("quiet-tenant-p99-ratio", "quiet_p99_ratio", "<=", 3.0),
+        SLO("no-quiet-throttling", "quiet_429", "==", 0),
+        SLO("flood-throttled", "flood_429", ">=", 1),
+        SLO("no-lost-acked-writes", "lost_acked_writes", "==", 0),
+        SLO("no-lost-watch-events", "lost_watch_events", "==", 0),
+    ),
+)
+
+RECONNECT_STORM = ScenarioSpec(
+    name="reconnect-storm",
+    description="Every watch stream in the fleet severed in the same "
+                "instant while writes continue; all observers resume "
+                "from their last RV at once. The retained watch window "
+                "must absorb the storm: zero lost events, zero "
+                "unrecoverable (410) resumes.",
+    topology="monolith",
+    tenants=6,
+    watchers_per_tenant=4,
+    phases=(Phase("warm", ops_per_tenant=20),
+            Phase("storm", ops_per_tenant=50, action="drop_watchers"),
+            Phase("recover", ops_per_tenant=20)),
+    options={"pace_s": 0.005},
+    slos=(
+        SLO("no-lost-watch-events", "lost_watch_events", "==", 0),
+        SLO("no-unrecoverable-resumes", "gone_410", "==", 0),
+        SLO("storm-happened", "reconnects", ">=", 1),
+        SLO("convergence", "p99_convergence_ms", "<=", 1500.0),
+        SLO("no-lost-acked-writes", "lost_acked_writes", "==", 0),
+        SLO("error-budget-5xx", "http_5xx", "==", 0),
+    ),
+)
+
+ROLLING_RESTART = ScenarioSpec(
+    name="rolling-restart",
+    description="A durable shard fleet behind the router restarted one "
+                "shard at a time USING GRACEFUL DRAIN, under live "
+                "writes and watches: zero lost acked writes, zero lost "
+                "watch events, every stream ended by a terminal Status. "
+                "The same workload re-runs with drain bypassed (kill) "
+                "and must demonstrate the breach drain prevents.",
+    topology="fleet",
+    topology_args={"shards": 2},
+    tenants=6,
+    watchers_per_tenant=2,
+    phases=(Phase("warm", ops_per_tenant=20),
+            Phase("restart", ops_per_tenant=90,
+                  action="rolling_restart_drain", settle_s=1.0)),
+    options={"pace_s": 0.02, "compare_kill": True,
+             "coverage_timeout_s": 25.0},
+    slos=(
+        SLO("no-lost-acked-writes", "lost_acked_writes", "==", 0),
+        SLO("no-lost-watch-events", "lost_watch_events", "==", 0),
+        SLO("no-unclean-stream-deaths", "unclean_stream_ends", "==", 0),
+        SLO("drain-terminated-streams", "terminal_statuses", ">=", 1),
+        SLO("error-budget-5xx", "http_5xx", "<=", 400),
+        SLO("kill-bypass-breaches", "bypass_stream_breaches", ">=", 1),
+    ),
+)
+
+KILL_PRIMARY = ScenarioSpec(
+    name="kill-primary",
+    description="SIGKILL the primary mid-workload behind a router with "
+                "standby + replica: the standby promotes, the replica "
+                "re-homes its feed onto the promoted standby, the "
+                "router re-routes writes to it — no manual restarts, "
+                "zero acked writes lost.",
+    topology="replicated",
+    tenants=5,
+    watchers_per_tenant=2,
+    phases=(Phase("warm", ops_per_tenant=25),
+            Phase("failover", ops_per_tenant=80, action="kill_primary",
+                  faults="repl.ship:latency=2ms", settle_s=1.5),
+            Phase("recovered", ops_per_tenant=25, settle_s=1.0)),
+    options={"pace_s": 0.02, "coverage_timeout_s": 30.0},
+    slos=(
+        SLO("no-lost-acked-writes", "lost_acked_writes", "==", 0),
+        SLO("standby-promoted", "repl_promotions", ">=", 1),
+        SLO("replica-rehomed", "repl_rehome", ">=", 1),
+        SLO("router-rerouted-writes", "router_rehome", ">=", 1),
+        SLO("no-lost-watch-events", "lost_watch_events", "==", 0),
+        SLO("error-budget-5xx", "http_5xx", "<=", 600),
+    ),
+)
+
+CRD_CHURN = ScenarioSpec(
+    name="crd-churn",
+    description="Per-tenant CRD creation, schema-negotiation churn and "
+                "teardown with live CR traffic: a created CRD must "
+                "become servable within the convergence bound, schema "
+                "updates must not blip serving, and a deleted CRD's "
+                "endpoint must 404 promptly.",
+    topology="monolith",
+    topology_args={"controllers": True},
+    tenants=4,
+    watchers_per_tenant=0,
+    workload="crd",
+    phases=(Phase("establish", ops_per_tenant=15, settle_s=0.5),
+            Phase("negotiate", ops_per_tenant=25, settle_s=0.5)),
+    slos=(
+        SLO("schema-negotiation-convergence", "crd_servable_p99_ms",
+            "<=", 5000.0),
+        SLO("all-crds-established", "crd_unestablished", "==", 0),
+        SLO("all-crds-torn-down", "crd_undestroyed", "==", 0),
+        SLO("no-lost-acked-cr-writes", "lost_acked_writes", "==", 0),
+        SLO("error-budget-5xx", "http_5xx", "==", 0),
+    ),
+)
+
+SCENARIOS: dict[str, ScenarioSpec] = {
+    s.name: s for s in (CRUD_CHURN, NOISY_NEIGHBOR, RECONNECT_STORM,
+                        ROLLING_RESTART, KILL_PRIMARY, CRD_CHURN)
+}

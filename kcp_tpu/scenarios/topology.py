@@ -1,0 +1,266 @@
+"""Topology drivers: the real server constellations scenarios run on.
+
+Everything here drives REAL servers over real HTTP — ServerThread per
+process-analog, each with its own event loop and store, exactly the
+harness discipline tests/helpers.py established (its ``shard_fleet`` /
+``restart_shard`` now live here and are re-exported there). Three
+shapes cover the deployment matrix the scenarios exercise:
+
+- :class:`Monolith` — one server (optionally with in-process
+  controllers, for the CRD/schema-negotiation scenarios);
+- :class:`RouterFleet` — N durable shards behind a ``--role router``
+  scatter-gather frontend, restartable one at a time (gracefully via
+  :meth:`~kcp_tpu.server.server.Server.drain` or abruptly via
+  ``kill()`` — the rolling-restart scenario's A/B);
+- :class:`ReplicatedPrimary` — primary + standby + replica behind a
+  router whose shard entry lists the followers as read replicas; the
+  kill-the-primary scenario's stage (standby promotion, replica
+  re-homing, router write re-routing).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+import time
+from urllib.parse import urlsplit
+
+from ..server.server import Config
+from ..server.threaded import ServerThread
+
+
+# ---------------------------------------------------------------------------
+# fleet primitives (moved from tests/helpers.py; re-exported there)
+# ---------------------------------------------------------------------------
+
+
+@contextlib.contextmanager
+def shard_fleet(n: int, tls: bool = False, durable: bool = False,
+                root_dir: str | None = None):
+    """A sharded control plane: ``n`` shard servers plus a router
+    fronting them over a consistent-hash ring.
+
+    Yields ``(router_thread, shard_threads, ring)``; ``shard_threads``
+    is a mutable list so chaos tests can kill and
+    :func:`restart_shard` entries in place. ``durable=True`` gives each
+    shard a WAL under ``root_dir/shard<i>`` so a restarted shard
+    resumes with its data AND its RV sequence (the honest recovery
+    story; in-memory shards come back empty at RV 0)."""
+    from ..sharding import ShardRing
+
+    if durable and root_dir is None:
+        raise ValueError("durable shard_fleet needs a root_dir")
+    shards: list[ServerThread] = []
+    router = None
+    try:
+        for i in range(n):
+            kw: dict = dict(durable=durable, install_controllers=False,
+                            tls=tls)
+            if durable:
+                kw["root_dir"] = os.path.join(root_dir, f"shard{i}")
+            shards.append(ServerThread(Config(**kw)).start())
+        spec = ",".join(f"s{i}={t.address}" for i, t in enumerate(shards))
+        router = ServerThread(Config(role="router", shards=spec,
+                                     durable=False, tls=tls)).start()
+        yield router, shards, ShardRing.from_spec(spec)
+    finally:
+        if router is not None:
+            router.stop()
+        for s in shards:
+            s.stop()
+
+
+def restart_shard(shards: list, i: int, timeout: float = 30.0):
+    """Restart shard ``i`` on its OLD address (the ring entry is fixed
+    at fleet start — a revived shard must come back where the router
+    expects it). The old thread must already be stopped."""
+    old = shards[i]
+    cfg = dataclasses.replace(old.server.config,
+                              listen_port=urlsplit(old.address).port)
+    # the freed port can linger briefly; retry the bind a few times
+    last: Exception | None = None
+    for _ in range(10):
+        try:
+            shards[i] = ServerThread(cfg).start(timeout=timeout)
+            return shards[i]
+        except RuntimeError as e:  # port not yet released
+            last = e
+            time.sleep(0.2)
+    raise last
+
+
+# ---------------------------------------------------------------------------
+# scenario topologies
+# ---------------------------------------------------------------------------
+
+
+@contextlib.contextmanager
+def _env_patch(env: dict):
+    """Apply server-process env overrides for the duration of server
+    CONSTRUCTION (flow-control rates, drain budgets — read once at
+    startup); restored immediately after so scenarios compose."""
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update({k: str(v) for k, v in env.items()})
+    try:
+        yield
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+class Monolith:
+    """One server process; controllers optional (CRD scenarios)."""
+
+    kind = "monolith"
+
+    def __init__(self, root_dir: str, env: dict | None = None,
+                 durable: bool = False, controllers: bool = False):
+        self.root_dir = root_dir
+        self.env = env or {}
+        self.durable = durable
+        self.controllers = controllers
+        self.server: ServerThread | None = None
+
+    def start(self) -> "Monolith":
+        kw: dict = dict(durable=self.durable,
+                        install_controllers=self.controllers, tls=False)
+        if self.durable:
+            kw["root_dir"] = os.path.join(self.root_dir, "mono")
+        with _env_patch(self.env):
+            self.server = ServerThread(Config(**kw)).start()
+        return self
+
+    @property
+    def client_url(self) -> str:
+        return self.server.address
+
+    def stop(self) -> None:
+        if self.server is not None:
+            self.server.stop()
+            self.server = None
+
+
+class RouterFleet:
+    """N durable shards behind a router, restartable in place."""
+
+    kind = "fleet"
+
+    def __init__(self, root_dir: str, env: dict | None = None,
+                 shards: int = 2, durable: bool = True):
+        self.root_dir = root_dir
+        self.env = env or {}
+        self.n = shards
+        self.durable = durable
+        self.shards: list[ServerThread] = []
+        self.router: ServerThread | None = None
+
+    def start(self) -> "RouterFleet":
+        with _env_patch(self.env):
+            for i in range(self.n):
+                kw: dict = dict(durable=self.durable,
+                                install_controllers=False, tls=False)
+                if self.durable:
+                    kw["root_dir"] = os.path.join(self.root_dir,
+                                                  f"shard{i}")
+                self.shards.append(ServerThread(Config(**kw)).start())
+            spec = ",".join(f"s{i}={t.address}"
+                            for i, t in enumerate(self.shards))
+            self.router = ServerThread(Config(role="router", shards=spec,
+                                              durable=False,
+                                              tls=False)).start()
+        return self
+
+    @property
+    def client_url(self) -> str:
+        return self.router.address
+
+    def restart_shard(self, i: int, drain: bool = True) -> None:
+        """Take shard ``i`` down (gracefully or by SIGKILL-equivalent)
+        and bring it back on its old address — one step of a rolling
+        restart."""
+        if drain:
+            self.shards[i].drain()
+        else:
+            self.shards[i].kill()
+        restart_shard(self.shards, i)
+
+    def stop(self) -> None:
+        if self.router is not None:
+            self.router.stop()
+            self.router = None
+        for s in self.shards:
+            s.stop()
+        self.shards = []
+
+
+class ReplicatedPrimary:
+    """Primary + standby + replica behind a router (one ring entry with
+    the followers as read replicas). The replica's ``--primary`` is the
+    CANDIDATE list ``primary,standby`` so re-homing engages after a
+    failover."""
+
+    kind = "replicated"
+
+    def __init__(self, root_dir: str, env: dict | None = None,
+                 hysteresis_s: float = 0.6):
+        self.root_dir = root_dir
+        self.env = env or {}
+        self.hysteresis_s = hysteresis_s
+        self.primary: ServerThread | None = None
+        self.standby: ServerThread | None = None
+        self.replica: ServerThread | None = None
+        self.router: ServerThread | None = None
+
+    def start(self) -> "ReplicatedPrimary":
+        with _env_patch(self.env):
+            self.primary = ServerThread(Config(
+                durable=True, install_controllers=False, tls=False,
+                root_dir=os.path.join(self.root_dir, "p"))).start()
+            self.standby = ServerThread(Config(
+                role="standby", primary=self.primary.address,
+                repl_hysteresis_s=self.hysteresis_s,
+                durable=True, install_controllers=False, tls=False,
+                root_dir=os.path.join(self.root_dir, "s"))).start()
+            self.replica = ServerThread(Config(
+                role="replica",
+                primary=f"{self.primary.address},{self.standby.address}",
+                repl_hysteresis_s=self.hysteresis_s,
+                durable=True, install_controllers=False, tls=False,
+                root_dir=os.path.join(self.root_dir, "r"))).start()
+            spec = (f"s0={self.primary.address}|{self.standby.address}"
+                    f"|{self.replica.address}")
+            self.router = ServerThread(Config(
+                role="router", shards=spec, durable=False,
+                tls=False)).start()
+        return self
+
+    @property
+    def client_url(self) -> str:
+        return self.router.address
+
+    def kill_primary(self) -> None:
+        """SIGKILL-equivalent primary death (Server.kill: no WAL
+        compaction, streams die mid-chunk)."""
+        self.primary.kill()
+
+    def stop(self) -> None:
+        for t in (self.router, self.replica, self.standby, self.primary):
+            if t is not None:
+                t.stop()
+        self.router = self.replica = self.standby = self.primary = None
+
+
+def make_topology(spec, root_dir: str):
+    """Instantiate the topology a spec names."""
+    args = dict(spec.topology_args)
+    if spec.topology == "monolith":
+        return Monolith(root_dir, env=spec.env, **args)
+    if spec.topology == "fleet":
+        return RouterFleet(root_dir, env=spec.env, **args)
+    if spec.topology == "replicated":
+        return ReplicatedPrimary(root_dir, env=spec.env, **args)
+    raise ValueError(f"unknown topology {spec.topology!r}")

@@ -137,6 +137,11 @@ class RestHandler:
         self.repl_applier = None
         self.repl_role = "primary"
         self.repl_lag_max = 0
+        # graceful drain (Server.drain): once set, every live watch
+        # producer flushes its buffered events, sends a terminal
+        # in-stream Status, and returns — the half of "no watcher is
+        # abandoned mid-stream" that the HTTP layer cannot do alone
+        self.draining = asyncio.Event()
 
     async def _st(self, fn, *args, **kwargs):
         """Run a store call; offloaded to the I/O pool for remote stores."""
@@ -171,6 +176,17 @@ class RestHandler:
     # ------------------------------------------------------------- routing
 
     async def __call__(self, req: Request) -> Response | StreamResponse:
+        if self.draining.is_set():
+            # graceful drain: in-flight requests were waited out BEFORE
+            # the flag flipped; anything arriving now (a request that
+            # raced the listener close on a kept-alive connection) must
+            # not commit AFTER the watchers' final flush — refuse 503 so
+            # the client retries against a live endpoint. Without this,
+            # a write landing post-flush is a WAL record no stream ever
+            # carried: the restarted server's history starts past it and
+            # honest resumes answer 410 (a real lost-event window).
+            return _error_response(errors.UnavailableError(
+                "server is draining; retry against a live endpoint"))
         segs = [s for s in req.path.split("/") if s]
         cluster = req.headers.get(CLUSTER_HEADER, DEFAULT_CLUSTER)
         if len(segs) >= 2 and segs[0] == "clusters":
@@ -678,6 +694,7 @@ class RestHandler:
                 body["lag_records"] = ap.lag_records
                 body["connected"] = ap.connected
                 body["primary"] = ap.primary_url
+                body["primary_candidates"] = list(ap.candidates)
             if self.repl_hub is not None:
                 body["subscribers"] = len(self.repl_hub._subs)
             return Response.of_json(body)
@@ -809,90 +826,169 @@ class RestHandler:
                 return
             loop = asyncio.get_event_loop()
             deadline = loop.time() + timeout if timeout else None
+            drain_task: asyncio.Task | None = None
+
+            async def send_batch(batch) -> None:
+                # coalesce whatever else the watch already buffered
+                # (the store's batched fan-out delivers in bursts)
+                # into one chunk/one drain instead of a write per
+                # event; drain() never raises, so error mapping below
+                # is unaffected. Streams without the batch method
+                # (test fakes/duck types) get the per-event sends.
+                send_raw = (getattr(stream, "send_raw_many", None)
+                            if self._encode else None)
+                send_many = getattr(stream, "send_json_many", None)
+                if send_raw is not None:
+                    # encode-once: every relay serving this store
+                    # splices the same cached event-line bytes — a
+                    # 64-watcher fan-out encodes each event once
+                    t0 = loop.time()
+                    lines = self.store.encode_events(batch)
+                    self._enc_seconds.observe(loop.time() - t0)
+                    await send_raw(lines)
+                elif send_many is not None:
+                    await send_many(
+                        [{"type": e.type, "object": e.object} for e in batch])
+                else:
+                    for e in batch:
+                        await stream.send_json({"type": e.type,
+                                                "object": e.object})
+
+            async def flush_and_terminate() -> None:
+                # graceful drain: every event the fan-out already queued
+                # is delivered, then a final BOOKMARK anchors the client
+                # at the store's true position — DELETED events carry
+                # the object's last-written RV, so a client that saw
+                # every event can still trail the store RV, and resuming
+                # from that trailing RV against the restarted server's
+                # empty history would answer a false 410. The terminal
+                # in-stream Status then tells the client this stream
+                # ends deliberately: resume from the bookmark, nothing
+                # was swallowed.
+                batch = watch.drain()
+                if batch:
+                    await send_batch(batch)
+                rv_now = (getattr(watch, "last_rv", 0) if self._remote
+                          else self.store.resource_version)
+                if rv_now:
+                    await stream.send_json({
+                        "type": "BOOKMARK",
+                        "object": {"kind": "Bookmark", "metadata": {
+                            "resourceVersion": str(rv_now)}},
+                    })
+                await stream.send_json({
+                    "type": "ERROR",
+                    "object": _status_body(
+                        503, "ServiceUnavailable",
+                        "server is draining; resume from your last "
+                        "resourceVersion")})
+
+            nxt: asyncio.Task | None = None
             try:
                 it = watch.__aiter__()
                 while True:
+                    if self.draining.is_set():
+                        await flush_and_terminate()
+                        return
                     step = bookmark_every if bookmarks else 3600.0
                     if deadline is not None:
                         step = min(step, max(0.0, deadline - loop.time()))
-                    try:
-                        ev = await asyncio.wait_for(it.__anext__(), timeout=step)
-                    except errors.ConflictError as e:
-                        # remote-store frontends surface an expired watch
-                        # window from the first iteration (the backend's
-                        # 410 arrives in-stream) rather than from watch()
-                        # — translate it the same way so clients relist
-                        # instead of seeing a silent connection drop
-                        await stream.send_json({
-                            "type": "ERROR",
-                            "object": _status_body(410, "Expired", e.message)})
-                        return
-                    except errors.ApiError as e:
-                        # any other backend refusal mid-relay (403/404/
-                        # 5xx mapped by the REST client) ends the stream
-                        # with a terminal Status carrying the real code,
-                        # not a silent connection drop (ADVICE r5)
-                        await stream.send_json({
-                            "type": "ERROR",
-                            "object": _status_body(e.code, e.reason,
-                                                   e.message)})
-                        return
-                    except asyncio.TimeoutError:
-                        if deadline is not None and loop.time() >= deadline:
-                            return  # server-side watch timeout: clean close
-                        # only bookmark when nothing is buffered: the store
-                        # RV may already cover an event still queued in this
-                        # watch, and a client resuming from such a bookmark
-                        # would skip that event forever
-                        if bookmarks and not watch.pending():
-                            # progress marker carrying the current RV so
-                            # clients can resume without replay. On a
-                            # remote-store frontend the store RV is ahead
-                            # of the relayed stream (an event can commit
-                            # backend-side while its chunk is still in
-                            # flight), so bookmark only what this stream
-                            # has DELIVERED (last_rv) — a fresher store
-                            # RV would let a resuming client skip that
-                            # in-flight event forever.
-                            if self._remote:
-                                rv_now = getattr(watch, "last_rv", 0)
-                                if not rv_now:
-                                    continue  # nothing delivered yet
-                            else:
-                                rv_now = self.store.resource_version
-                            await stream.send_json({
-                                "type": "BOOKMARK",
-                                "object": {"kind": "Bookmark", "metadata": {
-                                    "resourceVersion": str(rv_now)}},
-                            })
-                        continue
-                    except StopAsyncIteration:
-                        return
-                    # coalesce whatever else the watch already buffered
-                    # (the store's batched fan-out delivers in bursts)
-                    # into one chunk/one drain instead of a write per
-                    # event; drain() never raises, so error mapping above
-                    # is unaffected. Streams without the batch method
-                    # (test fakes/duck types) get the per-event sends.
-                    batch = [ev, *watch.drain()]
-                    send_raw = (getattr(stream, "send_raw_many", None)
-                                if self._encode else None)
-                    send_many = getattr(stream, "send_json_many", None)
-                    if send_raw is not None:
-                        # encode-once: every relay serving this store
-                        # splices the same cached event-line bytes — a
-                        # 64-watcher fan-out encodes each event once
-                        t0 = loop.time()
-                        lines = self.store.encode_events(batch)
-                        self._enc_seconds.observe(loop.time() - t0)
-                        await send_raw(lines)
-                    elif send_many is not None:
-                        await send_many(
-                            [{"type": e.type, "object": e.object} for e in batch])
+                    nxt = asyncio.ensure_future(it.__anext__())
+                    if drain_task is None:
+                        drain_task = asyncio.ensure_future(
+                            self.draining.wait())
+                    done, _ = await asyncio.wait(
+                        {nxt, drain_task}, timeout=step,
+                        return_when=asyncio.FIRST_COMPLETED)
+                    ev = None
+                    err: BaseException | None = None
+                    if nxt in done:
+                        try:
+                            ev = nxt.result()
+                        except BaseException as e:  # noqa: BLE001 — mapped below
+                            err = e
                     else:
-                        for e in batch:
-                            await stream.send_json({"type": e.type, "object": e.object})
+                        # timeout or drain woke us: reap the in-flight
+                        # __anext__ without losing an event that raced in
+                        # between wait() returning and the cancel
+                        nxt.cancel()
+                        try:
+                            ev = await nxt
+                        except (asyncio.CancelledError, StopAsyncIteration):
+                            ev = None
+                        except BaseException as e:  # noqa: BLE001 — mapped below
+                            err = e
+                    if err is not None:
+                        if isinstance(err, errors.ConflictError):
+                            # remote-store frontends surface an expired
+                            # watch window from the first iteration (the
+                            # backend's 410 arrives in-stream) rather than
+                            # from watch() — translate it the same way so
+                            # clients relist instead of seeing a silent
+                            # connection drop
+                            await stream.send_json({
+                                "type": "ERROR",
+                                "object": _status_body(410, "Expired",
+                                                       err.message)})
+                            return
+                        if isinstance(err, errors.ApiError):
+                            # any other backend refusal mid-relay (403/404/
+                            # 5xx mapped by the REST client) ends the stream
+                            # with a terminal Status carrying the real code,
+                            # not a silent connection drop (ADVICE r5)
+                            await stream.send_json({
+                                "type": "ERROR",
+                                "object": _status_body(err.code, err.reason,
+                                                       err.message)})
+                            return
+                        if isinstance(err, StopAsyncIteration):
+                            return
+                        raise err
+                    if ev is not None:
+                        await send_batch([ev, *watch.drain()])
+                        continue
+                    if self.draining.is_set():
+                        await flush_and_terminate()
+                        return
+                    if deadline is not None and loop.time() >= deadline:
+                        return  # server-side watch timeout: clean close
+                    # only bookmark when nothing is buffered: the store
+                    # RV may already cover an event still queued in this
+                    # watch, and a client resuming from such a bookmark
+                    # would skip that event forever
+                    if bookmarks and not watch.pending():
+                        # progress marker carrying the current RV so
+                        # clients can resume without replay. On a
+                        # remote-store frontend the store RV is ahead
+                        # of the relayed stream (an event can commit
+                        # backend-side while its chunk is still in
+                        # flight), so bookmark only what this stream
+                        # has DELIVERED (last_rv) — a fresher store
+                        # RV would let a resuming client skip that
+                        # in-flight event forever.
+                        if self._remote:
+                            rv_now = getattr(watch, "last_rv", 0)
+                            if not rv_now:
+                                continue  # nothing delivered yet
+                        else:
+                            rv_now = self.store.resource_version
+                        await stream.send_json({
+                            "type": "BOOKMARK",
+                            "object": {"kind": "Bookmark", "metadata": {
+                                "resourceVersion": str(rv_now)}},
+                        })
             finally:
+                # reap outstanding helper tasks without awaiting (this
+                # block also runs under cancellation): the callback
+                # retrieves any late exception (watch.close() below
+                # completes a pending __anext__ with StopAsyncIteration)
+                # so the loop never logs "exception was never retrieved"
+                for t in (nxt, drain_task):
+                    if t is not None and not t.done():
+                        t.cancel()
+                    if t is not None:
+                        t.add_done_callback(
+                            lambda t: t.cancelled() or t.exception())
                 watch.close()
 
         return StreamResponse(produce)

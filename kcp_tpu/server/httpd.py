@@ -162,6 +162,13 @@ class HttpServer:
         self.ssl_context = ssl_context
         self._server: asyncio.AbstractServer | None = None
         self._conns: set[asyncio.Task] = set()
+        # graceful drain state: once draining, the listener is closed
+        # (late connections are refused at the TCP level), idle
+        # keep-alive connections are torn down, and in-flight responses
+        # force ``Connection: close``
+        self._draining = False
+        self._idle: set[asyncio.StreamWriter] = set()
+        self._busy = 0  # requests currently between parse and response
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
@@ -186,6 +193,53 @@ class HttpServer:
         scheme = "https" if self.ssl_context else "http"
         return f"{scheme}://{self.host}:{self.port}"
 
+    # ------------------------------------------------------------- drain
+
+    def begin_drain(self) -> None:
+        """Stop accepting work: close the listener (late connections are
+        refused), tear down idle keep-alive connections, and mark every
+        in-flight response ``Connection: close``. In-flight requests and
+        open streams keep running — :meth:`finish_drain` bounds them."""
+        self._draining = True
+        if self._server is not None:
+            self._server.close()
+        for w in list(self._idle):
+            try:
+                w.close()
+            except Exception:  # noqa: BLE001 — already-dead transport
+                pass
+
+    async def wait_requests_idle(self, deadline: float) -> bool:
+        """Wait until no request is between parse and response write
+        (watch streams excluded — they end via the handler's drain
+        signal). Returns False if the deadline expired first."""
+        loop = asyncio.get_running_loop()
+        while self._busy > 0:
+            if loop.time() >= deadline:
+                return False
+            await asyncio.sleep(0.005)
+        return True
+
+    async def finish_drain(self, deadline: float) -> int:
+        """Wait for every connection task to finish (stream producers
+        end once the handler's draining signal is set); tasks still
+        alive at the deadline are cancelled. Returns the forced count."""
+        forced = 0
+        conns = set(self._conns)
+        if conns:
+            loop = asyncio.get_running_loop()
+            timeout = max(0.0, deadline - loop.time())
+            _done, pending = await asyncio.wait(conns, timeout=timeout)
+            for t in pending:
+                t.cancel()
+                forced += 1
+            if pending:
+                await asyncio.gather(*pending, return_exceptions=True)
+        if self._server is not None:
+            await self._server.wait_closed()
+            self._server = None
+        return forced
+
     async def _serve(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
         task = asyncio.current_task()
         if task is not None:
@@ -193,6 +247,7 @@ class HttpServer:
             task.add_done_callback(self._conns.discard)
         try:
             while True:
+                self._idle.add(writer)
                 try:
                     req = await self._read_request(reader)
                 except RequestTooLarge as e:
@@ -214,15 +269,40 @@ class HttpServer:
                         "Connection: close\r\n\r\n".encode() + body)
                     await writer.drain()
                     break
+                finally:
+                    self._idle.discard(writer)
                 if req is None:
                     break
+                keep = True
+                self._busy += 1
                 try:
-                    resp = await self.handler(req)
-                except Exception:  # handler bug — surface as 500, keep serving
-                    log.exception("handler error for %s %s", req.method, req.path)
-                    resp = Response.of_json(
-                        {"kind": "Status", "status": "Failure",
-                         "reason": "InternalError", "code": 500}, 500)
+                    try:
+                        resp = await self.handler(req)
+                    except Exception:  # handler bug — surface as 500, keep serving
+                        log.exception("handler error for %s %s",
+                                      req.method, req.path)
+                        resp = Response.of_json(
+                            {"kind": "Status", "status": "Failure",
+                             "reason": "InternalError", "code": 500}, 500)
+                    if not isinstance(resp, StreamResponse):
+                        # draining forces Connection: close so keep-alive
+                        # clients re-resolve instead of queueing more
+                        # requests on a server that is going away
+                        keep = (req.headers.get("connection", "keep-alive")
+                                != "close") and not self._draining
+                        head = (
+                            f"HTTP/1.1 {resp.status} {_reason(resp.status)}\r\n"
+                            f"Content-Type: {resp.content_type}\r\n"
+                            f"Content-Length: {len(resp.body)}\r\n"
+                        )
+                        for k, v in resp.headers.items():
+                            head += f"{k}: {v}\r\n"
+                        head += ("Connection: "
+                                 f"{'keep-alive' if keep else 'close'}\r\n\r\n")
+                        writer.write(head.encode() + resp.body)
+                        await writer.drain()
+                finally:
+                    self._busy -= 1
                 if isinstance(resp, StreamResponse):
                     await resp._begin(writer)
                     # watch the socket for client disconnect: an idle stream
@@ -246,17 +326,6 @@ class HttpServer:
                                     "stream producer failed", exc_info=r)
                     await resp._finish()
                     break  # streams always close the connection
-                keep = req.headers.get("connection", "keep-alive") != "close"
-                head = (
-                    f"HTTP/1.1 {resp.status} {_reason(resp.status)}\r\n"
-                    f"Content-Type: {resp.content_type}\r\n"
-                    f"Content-Length: {len(resp.body)}\r\n"
-                )
-                for k, v in resp.headers.items():
-                    head += f"{k}: {v}\r\n"
-                head += f"Connection: {'keep-alive' if keep else 'close'}\r\n\r\n"
-                writer.write(head.encode() + resp.body)
-                await writer.drain()
                 if not keep:
                     break
         except (ConnectionError, asyncio.IncompleteReadError, asyncio.LimitOverrunError):

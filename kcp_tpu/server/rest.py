@@ -109,6 +109,9 @@ class RestWatch:
         self._task: asyncio.Task | None = None
         self._closed = False
         self.error: Exception | None = None  # set on non-2xx watch responses
+        self.responded = False  # True once the server sent a status line —
+        # lets consumers tell "connect refused" from "established stream
+        # died" (the scenario harness's unclean-death accounting)
         self.last_rv = 0  # highest RV seen (events + bookmarks), for resume
         # chunk reassembly state (_feed): decoded-but-incomplete trailing
         # line, and an incremental UTF-8 decoder so each chunk is decoded
@@ -136,6 +139,7 @@ class RestWatch:
             head = await reader.readuntil(b"\r\n\r\n")
             status_line = head.split(b"\r\n", 1)[0].decode("latin-1")
             code = int(status_line.split(" ")[1])
+            self.responded = True
             if code >= 400:
                 body = await reader.read(64 * 1024)
                 # strip chunked framing if present; _raise_for_status just

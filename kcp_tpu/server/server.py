@@ -82,7 +82,17 @@ class Config:
     shards: str = ""  # router role: comma-separated [name=]url shard list
     # (KCP_SHARDS env is the fallback; see kcp_tpu/sharding/ring.py)
     primary: str = ""  # replica/standby roles: the primary's base URL
-    # (the /replication/wal feed source and the health-probe target)
+    # (the /replication/wal feed source and the health-probe target).
+    # Accepts a comma-separated CANDIDATE list ("url1,url2"): a replica
+    # whose current primary stays dead or fenced past the hysteresis
+    # window probes the candidates in order and re-homes onto whichever
+    # one serves as the live primary (the promoted standby after a
+    # failover). KCP_PRIMARY env is the fallback for the flag.
+    drain_timeout_s: float | None = None  # graceful-drain budget for
+    # Server.drain (None -> KCP_DRAIN_TIMEOUT_S, default 5.0): the wall
+    # bound on stop-accepting + finish-in-flight + terminal watch
+    # Status + replication flush; whatever is still alive at the
+    # deadline is cut off hard
     repl_hysteresis_s: float | None = None  # standby promotion: how long
     # the primary's breaker must stay open before the standby promotes
     # (None -> KCP_REPL_HYSTERESIS_S, default 3.0s). Too low and a slow
@@ -164,6 +174,10 @@ class Server:
             self._stop = asyncio.Event()
             return
         if self.config.role in ("replica", "standby"):
+            if not self.config.primary:
+                # KCP_PRIMARY env is the flag's fallback (and carries the
+                # same comma-separated candidate-list form)
+                self.config.primary = os.environ.get("KCP_PRIMARY", "")
             if not self.config.primary:
                 raise ValueError(
                     f"--role {self.config.role} needs --primary (the "
@@ -249,7 +263,10 @@ class Server:
         self.http = HttpServer(self.handler, self.config.listen_host,
                                self.config.listen_port,
                                ssl_context=ssl_context)
-        self.client = MultiClusterClient(self.store)
+        # the in-process client SHARES the serving scheme: controller-
+        # registered CRDs (crdlifecycle.py) must be visible to the REST
+        # handler, or a CRD created over REST never serves its CRs
+        self.client = MultiClusterClient(self.store, scheme=self.scheme)
         self._controllers: list = []
         self._post_start_hooks: list = []
         self._stop = asyncio.Event()
@@ -414,6 +431,78 @@ class Server:
 
     def stop(self) -> None:
         self._stop.set()
+
+    async def drain(self, timeout: float | None = None) -> bool:
+        """Graceful drain (the SIGTERM path): stop accepting
+        connections, let in-flight requests finish, deliver buffered
+        watch events + a terminal in-stream Status to every live
+        watcher, flush the replication feed to subscribers, then return
+        True — the caller stops the server afterwards. The whole
+        sequence is bounded by ``timeout`` (KCP_DRAIN_TIMEOUT_S,
+        default 5.0 s): at the deadline, whatever is still alive is cut
+        off exactly as a hard stop would. Returns False when the drain
+        was aborted (an injected ``server.drain`` fault) and the caller
+        should fall straight through to stop().
+        """
+        from ..faults import maybe_fail
+        from ..utils.trace import REGISTRY
+
+        if timeout is None:
+            timeout = (self.config.drain_timeout_s
+                       if self.config.drain_timeout_s is not None
+                       else float(os.environ.get("KCP_DRAIN_TIMEOUT_S",
+                                                 "5.0")))
+        loop = asyncio.get_running_loop()
+        gauge = REGISTRY.gauge(
+            "server_draining",
+            "1 while a graceful drain is in progress")
+        span = REGISTRY.histogram(
+            "server_drain_seconds",
+            "wall time of one graceful drain (stop accepting -> "
+            "in-flight done -> watchers terminated -> replication "
+            "flushed)")
+        t0 = loop.time()
+        deadline = t0 + max(0.0, timeout)
+        gauge.set(1)
+        try:
+            try:
+                delay = maybe_fail("server.drain")
+            except Exception as e:  # noqa: BLE001 — injected abort
+                log.warning("graceful drain aborted (%s); "
+                            "escalating to hard stop", e)
+                return False
+            if delay:
+                await asyncio.sleep(delay)
+            # 1. stop accepting: listener closed (late connections are
+            # refused at connect time), idle keep-alive conns torn down
+            self.http.begin_drain()
+            # 2. in-flight requests finish (semi-sync repl waits
+            # included); watch streams are excluded — they end in step 3
+            if not await self.http.wait_requests_idle(deadline):
+                log.warning("drain: in-flight requests still running at "
+                            "the %.1fs deadline", timeout)
+            # 3. flush + terminate watchers and replication subscribers.
+            # The store's pending fan-out is flushed FIRST so the watch
+            # producers' final drain() sees every committed event.
+            if self.store is not None and hasattr(self.store,
+                                                  "_flush_events"):
+                self.store._flush_events()
+            draining = getattr(self.handler, "draining", None)
+            if draining is not None:
+                draining.set()
+            if self.repl_hub is not None:
+                self.repl_hub.drain()
+            # 4. wait for every connection to wind down; cut off hard at
+            # the deadline
+            forced = await self.http.finish_drain(deadline)
+            if forced:
+                log.warning("drain: %d connection(s) cut off at the "
+                            "%.1fs deadline", forced, timeout)
+            log.info("graceful drain complete in %.3fs", loop.time() - t0)
+            return True
+        finally:
+            span.observe(loop.time() - t0)
+            gauge.set(0)
 
     def kill(self) -> None:
         """Abrupt-death switch (the in-process SIGKILL emulation the

@@ -79,6 +79,18 @@ class ServerThread:
 
         return self.submit(_wrap())
 
+    def drain(self, timeout: float | None = None) -> None:
+        """Graceful stop (the SIGTERM path for embedded servers): run
+        Server.drain on the server loop — stop accepting, finish
+        in-flight requests, terminal Status to live watchers, flush
+        replication — then stop. Bounded by KCP_DRAIN_TIMEOUT_S."""
+        if self._loop is not None and self.server is not None:
+            try:
+                self.submit(self.server.drain(timeout))
+            except Exception:  # noqa: BLE001 — loop already down: a drain
+                pass  # racing a kill/stop degrades to the plain stop below
+        self.stop()
+
     def kill(self) -> None:
         """Abrupt stop (SIGKILL emulation for kill drills): no WAL
         compaction, in-flight streams die mid-chunk. See Server.kill."""

@@ -50,6 +50,7 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from urllib.parse import quote, urlencode, urlsplit
 
+from ..analysis.sanitize import make_lock
 from ..faults import maybe_fail
 from ..server.handler import CLUSTER_HEADER, DEFAULT_CLUSTER, _error_response, _status_body
 from ..server.httpd import Request, Response, StreamResponse
@@ -164,6 +165,21 @@ class RouterHandler:
             "router_replica_fallback_total",
             "replica reads that fell back to the primary (replica "
             "unreachable or refusing)")
+        # promotion discovery: repeated 503/unreachable answers from a
+        # shard's primary trigger a probe of the shard's replica list;
+        # a replica answering /replication/status as role=primary is the
+        # promoted standby — write routing swaps onto it in place, no
+        # router restart. State is touched from executor threads, so the
+        # counters/probe clock sit behind a lock (pool swaps themselves
+        # are atomic whole-list/whole-slot assignments).
+        self._rehomes = REGISTRY.counter(
+            "router_rehome_total",
+            "times the router swapped a shard's write routing onto a "
+            "promoted replica after its primary died or was fenced")
+        self._rehome_lock = make_lock("router.rehome")
+        self._primary_fails = [0] * len(ring)
+        self._last_probe = [0.0] * len(ring)
+        self._retired: list[ConnectionPool] = []
 
     def close(self) -> None:
         self._exec.shutdown(wait=False, cancel_futures=True)
@@ -172,36 +188,122 @@ class RouterHandler:
         for rp in self._rpools:
             for p in rp:
                 p.close()
+        for p in self._retired:
+            p.close()
 
     # ----------------------------------------------------------- plumbing
 
     def _shard_call(self, idx: int, method: str, target: str,
                     payload: bytes | None, headers: dict[str, str],
                     pool: ConnectionPool | None = None, who: str = "",
+                    _rehomed: bool = False,
                     ) -> tuple[int, dict[str, str], bytes]:
         """One raw relay round trip to shard ``idx`` (executor thread);
-        ``pool`` overrides the primary pool for replica-routed reads."""
+        ``pool`` overrides the primary pool for replica-routed reads.
+        Primary relays that fail 503/unreachable feed the promotion-
+        discovery counter; when discovery swaps routing onto a promoted
+        replica the call retries ONCE against the new primary."""
         delay = maybe_fail("router.proxy")
         if delay:
             time.sleep(delay)
-        if pool is None:
-            pool = self._pools[idx]
+        primary_call = pool is None
+        use = self._pools[idx] if primary_call else pool
         t0 = time.perf_counter()
         try:
-            with pool.client() as c:
-                return c.request_raw(method, target, payload, headers)
+            with use.client() as c:
+                status, rheaders, body = c.request_raw(
+                    method, target, payload, headers)
         except errors.UnavailableError:
             # breaker fail-fast: already the right type, just count it
             self._unavailable.inc()
+            if primary_call and not _rehomed \
+                    and self._note_primary_failure(idx):
+                return self._shard_call(idx, method, target, payload,
+                                        headers, None, who, _rehomed=True)
             raise
         except (ConnectionError, OSError, TimeoutError,
                 http.client.HTTPException) as e:
             self._unavailable.inc()
+            if primary_call and not _rehomed \
+                    and self._note_primary_failure(idx):
+                return self._shard_call(idx, method, target, payload,
+                                        headers, None, who, _rehomed=True)
             raise errors.UnavailableError(
                 f"shard {who or self.ring.shards[idx].name} "
                 f"unreachable: {e}") from e
         finally:
             self._proxy_seconds.observe(time.perf_counter() - t0)
+        if primary_call:
+            if status == 503:
+                # a fenced / mid-promotion ex-primary ANSWERS but refuses
+                # (store read-only 503): that is a dead write endpoint
+                # for discovery purposes, even though transport is up
+                if not _rehomed and self._note_primary_failure(idx):
+                    return self._shard_call(idx, method, target, payload,
+                                            headers, None, who,
+                                            _rehomed=True)
+            else:
+                with self._rehome_lock:
+                    self._primary_fails[idx] = 0
+        return status, rheaders, body
+
+    def _note_primary_failure(self, idx: int) -> bool:
+        """Count a consecutive primary-relay failure for shard ``idx``;
+        at the threshold, probe the shard's replicas for a promoted
+        primary (``/replication/status`` role=primary, unfenced) and
+        swap write routing onto it in place. Returns True when routing
+        changed — the caller retries once against the new primary."""
+        now = time.monotonic()
+        with self._rehome_lock:
+            self._primary_fails[idx] += 1
+            if self._primary_fails[idx] < 2 or not self._rpools[idx]:
+                return False
+            if now - self._last_probe[idx] < 0.25:
+                return False  # probe at most ~4x/s per shard
+            self._last_probe[idx] = now
+            candidates = list(self._rpools[idx])
+        promoted = None
+        for p in candidates:
+            info = self._probe_status(p)
+            if (info is not None and not info.get("fenced")
+                    and info.get("role") == "primary"):
+                promoted = p
+                break
+        if promoted is None:
+            return False
+        with self._rehome_lock:
+            if self._pools[idx] is promoted:
+                return True  # another thread already swapped
+            old = self._pools[idx]
+            # whole-slot / whole-list assignments: concurrent readers
+            # hold snapshots of the old list and stay consistent
+            self._pools[idx] = promoted
+            self._rpools[idx] = [p for p in self._rpools[idx]
+                                 if p is not promoted]
+            # the dead primary pool is retired, not closed: in-flight
+            # calls may still hold its clients (closed at handler.close)
+            self._retired.append(old)
+            self._primary_fails[idx] = 0
+        self._rehomes.inc()
+        log.warning("shard %s: write routing re-homed %s -> %s "
+                    "(promoted replica)", self.ring.shards[idx].name,
+                    old.base_url, promoted.base_url)
+        return True
+
+    @staticmethod
+    def _probe_status(pool: ConnectionPool) -> dict | None:
+        """GET /replication/status through ``pool``; None when the
+        endpoint is unreachable or not a replication participant."""
+        try:
+            with pool.client() as c:
+                status, _h, body = c.request_raw("GET",
+                                                 "/replication/status")
+            if status != 200:
+                return None
+            out = json.loads(body)
+            return out if isinstance(out, dict) else None
+        except Exception:  # noqa: BLE001 — a failed probe is "not promoted"
+            return None
 
     async def _call(self, idx: int, method: str, target: str,
                     payload: bytes | None, headers: dict[str, str],

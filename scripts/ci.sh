@@ -215,6 +215,27 @@ print("replica smoke ok: %sx read capacity @2 | lag p99 %sms | kill: %d acked"
          kill["promote_ms"], kill["epoch"]))
 '
 
+echo "== scenarios: seeded end-to-end chaos smoke (churn + reconnect storm + kill-the-primary drill)"
+# reduced-scale subset of the scenario harness (scripts/scenarios.py):
+# real topologies over real HTTP, hard SLO floors (zero lost acked
+# writes, zero lost watch events, convergence bounds, failover
+# re-homing) asserted by the engine itself — exit 1 on any miss. The
+# scorecard JSON persists as a build artifact alongside the BENCH_*
+# files; the full catalog (incl. rolling-restart drain-vs-kill) runs
+# via `scripts/scenarios.py run --all --seed 42`.
+JAX_PLATFORMS=cpu python scripts/scenarios.py run \
+    --scenarios crud-churn,reconnect-storm,kill-primary \
+    --seed 42 --scale 0.4 --out SCENARIOS_smoke.json
+python -c '
+import json
+r = json.load(open("SCENARIOS_smoke.json"))
+assert r["passed"], "scenario smoke failed"
+for s in r["scenarios"]:
+    miss = [row["name"] for row in s["slos"] if not row["passed"]]
+    assert not miss, (s["name"], miss)
+print("scenario smoke ok:", {s["name"]: s["schedule"]["hash"] for s in r["scenarios"]})
+'
+
 if [[ "$fast" == "0" ]]; then
     echo "== demo: both golden scenarios, checked against committed output"
     python contrib/demo/run_demo.py all --check

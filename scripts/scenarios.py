@@ -1,0 +1,112 @@
+#!/usr/bin/env python3
+"""Run the scenario harness and emit one JSON scorecard.
+
+Usage:
+    python scripts/scenarios.py list
+    python scripts/scenarios.py run --all --seed 42 [--scale 1.0]
+        [--out SCENARIOS.json]
+    python scripts/scenarios.py run --scenarios crud-churn,kill-primary \
+        --seed 7 --scale 0.3
+
+Every run is seeded and replayable: the scorecard carries each
+scenario's schedule hash — a second run with the same seed reproduces
+the same op/fault schedule bit for bit (the determinism the engine's
+tests assert). Exit status 1 when any scenario misses a declared SLO.
+
+``--scale`` shrinks tenant counts and op volumes for CI smokes; SLO
+targets never scale (docs/operations.md "Scenario harness runbook").
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    p = argparse.ArgumentParser(description=__doc__)
+    sub = p.add_subparsers(dest="command", required=True)
+    sub.add_parser("list", help="list the named scenarios")
+    run = sub.add_parser("run", help="run scenarios, emit a scorecard")
+    run.add_argument("--all", action="store_true",
+                     help="run every scenario in the catalog")
+    run.add_argument("--scenarios", default="",
+                     help="comma-separated scenario names")
+    run.add_argument("--seed", type=int, default=42)
+    run.add_argument("--scale", type=float, default=1.0,
+                     help="tenant/op scale factor (SLO targets do NOT "
+                          "scale)")
+    run.add_argument("--out", default="",
+                     help="scorecard JSON path (default: stdout only)")
+    run.add_argument("--workdir", default="",
+                     help="server root dirs (default: a fresh tempdir)")
+    args = p.parse_args(argv)
+
+    from kcp_tpu.scenarios import SCENARIOS, run_scenario
+
+    if args.command == "list":
+        for name, spec in SCENARIOS.items():
+            print(f"{name:18s} [{spec.topology}] {spec.description}")
+        return 0
+
+    if args.all:
+        names = list(SCENARIOS)
+    else:
+        names = [n for n in args.scenarios.split(",") if n]
+    if not names:
+        p.error("run needs --all or --scenarios a,b,c")
+    unknown = [n for n in names if n not in SCENARIOS]
+    if unknown:
+        p.error(f"unknown scenario(s) {unknown}; "
+                f"known: {sorted(SCENARIOS)}")
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="kcp-scenarios-")
+    t0 = time.time()
+    results = []
+    for name in names:
+        print(f"== scenario: {name} (seed={args.seed} "
+              f"scale={args.scale})", flush=True)
+        r = run_scenario(SCENARIOS[name], seed=args.seed,
+                         scale=args.scale, workdir=workdir)
+        results.append(r)
+        verdict = "PASS" if r["passed"] else "FAIL"
+        print(f"   {verdict} in {r.get('measurements', {}).get('duration_s', '?')}s "
+              f"schedule={r['schedule']['hash']}", flush=True)
+        for row in r["slos"]:
+            mark = "ok " if row["passed"] else "MISS"
+            print(f"   [{mark}] {row['name']}: {row['metric']} "
+                  f"{row['op']} {row['target']} "
+                  f"(observed {row['observed']})", flush=True)
+        if r.get("drain_bypassed"):
+            print(f"   drain bypassed (kill): {r['drain_bypassed']}",
+                  flush=True)
+
+    scorecard = {
+        "kind": "ScenarioScorecard",
+        "seed": args.seed,
+        "scale": args.scale,
+        "duration_s": round(time.time() - t0, 2),
+        "passed": all(r["passed"] for r in results),
+        "scenarios": results,
+    }
+    out = json.dumps(scorecard, indent=2, sort_keys=False)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(out + "\n")
+        print(f"scorecard written to {args.out}")
+    print(json.dumps({"passed": scorecard["passed"],
+                      "scenarios": {r["name"]: r["passed"]
+                                    for r in results}}))
+    return 0 if scorecard["passed"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -3,13 +3,19 @@
 (Several older files carry their own `eventually` variants with
 file-specific defaults and diagnostics; consolidating them would change
 per-file timeout behavior for no coverage gain, so only genuinely
-shared helpers live here.)"""
+shared helpers live here.)
+
+``shard_fleet`` / ``restart_shard`` moved to
+``kcp_tpu/scenarios/topology.py`` when the scenario harness landed —
+the engine drives the same fleets the tests do, so there is exactly one
+copy; they are re-exported here unchanged for the existing suites."""
 
 import asyncio
-import contextlib
-import dataclasses
-import os
-from urllib.parse import urlsplit
+
+from kcp_tpu.scenarios.topology import (  # noqa: F401 — re-exports
+    restart_shard,
+    shard_fleet,
+)
 
 
 async def wait_until(cond, timeout: float, interval: float = 0.02) -> bool:
@@ -21,67 +27,3 @@ async def wait_until(cond, timeout: float, interval: float = 0.02) -> bool:
             break
         await asyncio.sleep(interval)
     return cond()
-
-
-@contextlib.contextmanager
-def shard_fleet(n: int, tls: bool = False, durable: bool = False,
-                root_dir: str | None = None):
-    """A sharded control plane for tests: ``n`` shard servers plus a
-    router fronting them over a consistent-hash ring.
-
-    The first multi-process-shaped topology harness in the repo —
-    ROADMAP items 4 (replicas) and 5 (scenario harness) reuse it.
-    Yields ``(router_thread, shard_threads, ring)``; ``shard_threads``
-    is a mutable list so chaos tests can kill and
-    :func:`restart_shard` entries in place. ``durable=True`` gives each
-    shard a WAL under ``root_dir/shard<i>`` so a restarted shard
-    resumes with its data AND its RV sequence (the honest recovery
-    story; in-memory shards come back empty at RV 0)."""
-    from kcp_tpu.server.server import Config
-    from kcp_tpu.server.threaded import ServerThread
-    from kcp_tpu.sharding import ShardRing
-
-    if durable and root_dir is None:
-        raise ValueError("durable shard_fleet needs a root_dir")
-    shards: list[ServerThread] = []
-    router = None
-    try:
-        for i in range(n):
-            kw: dict = dict(durable=durable, install_controllers=False,
-                            tls=tls)
-            if durable:
-                kw["root_dir"] = os.path.join(root_dir, f"shard{i}")
-            shards.append(ServerThread(Config(**kw)).start())
-        spec = ",".join(f"s{i}={t.address}" for i, t in enumerate(shards))
-        router = ServerThread(Config(role="router", shards=spec,
-                                     durable=False, tls=tls)).start()
-        yield router, shards, ShardRing.from_spec(spec)
-    finally:
-        if router is not None:
-            router.stop()
-        for s in shards:
-            s.stop()
-
-
-def restart_shard(shards: list, i: int, timeout: float = 30.0):
-    """Restart shard ``i`` on its OLD address (the ring entry is fixed
-    at fleet start — a revived shard must come back where the router
-    expects it). The old thread must already be stopped."""
-    from kcp_tpu.server.threaded import ServerThread
-
-    old = shards[i]
-    cfg = dataclasses.replace(old.server.config,
-                              listen_port=urlsplit(old.address).port)
-    deadline = timeout
-    # the freed port can linger briefly; retry the bind a few times
-    last: Exception | None = None
-    for _ in range(10):
-        try:
-            shards[i] = ServerThread(cfg).start(timeout=deadline)
-            return shards[i]
-        except RuntimeError as e:  # port not yet released
-            last = e
-            import time
-
-            time.sleep(0.2)
-    raise last

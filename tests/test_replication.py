@@ -500,6 +500,66 @@ def wait_until_sync(primary: ServerThread, timeout: float = 10.0) -> bool:
     return False
 
 
+def test_replica_rehomes_onto_promoted_standby(tmp_path):
+    """The PR 9 follow-up drill: a replica configured with a CANDIDATE
+    list (``--primary p,s``) whose primary dies re-resolves to the
+    promoted standby after hysteresis — it follows the live epoch and
+    keeps applying new writes, no restart."""
+    primary = _server(root_dir=str(tmp_path / "p"))
+    standby = _server(role="standby", primary=primary.address,
+                      root_dir=str(tmp_path / "s"), hysteresis=0.4)
+    replica = ServerThread(Config(
+        durable=False, install_controllers=False, tls=False,
+        role="replica",
+        primary=f"{primary.address},{standby.address}",
+        repl_hysteresis_s=0.4)).start()
+    try:
+        assert wait_until_sync(primary)
+        pc = RestClient(primary.address, cluster="t1")
+        for i in range(10):
+            pc.create("configmaps", _cm(f"pre{i}", "t1", str(i)))
+        pc.close()
+        _wait_applied(replica.address, 10)
+        st = _repl_status(replica.address)
+        assert st["primary"] == primary.address
+        assert st["primary_candidates"] == [primary.address,
+                                            standby.address]
+
+        before = REGISTRY.counter("repl_rehome_total").value
+        primary.kill()
+
+        # the standby promotes; the replica's probe loop finds its
+        # configured primary dead past hysteresis, probes the candidate
+        # list, and adopts the promoted standby + its epoch
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            st = _repl_status(replica.address)
+            if st["primary"] == standby.address and st["connected"]:
+                break
+            time.sleep(0.1)
+        assert st["primary"] == standby.address, st
+        assert REGISTRY.counter("repl_rehome_total").value == before + 1
+        assert _repl_status(standby.address)["role"] == "primary"
+
+        # new writes on the promoted primary reach the re-homed replica
+        sc = RestClient(standby.address, cluster="t1")
+        for i in range(5):
+            sc.create("configmaps", _cm(f"post{i}", "t1", str(i)))
+        sc.close()
+        _wait_applied(replica.address, 15)
+        rc = RestClient(replica.address, cluster="t1")
+        items, _rv = rc.list("configmaps", namespace="default")
+        assert {o["metadata"]["name"] for o in items} >= {
+            f"post{i}" for i in range(5)}
+        st = _repl_status(replica.address)
+        assert st["epoch"] == 1 and st["role"] == "replica"
+        rc.close()
+    finally:
+        replica.stop()
+        standby.stop()
+        primary.stop()
+
+
 def test_differential_fuzz_under_repl_chaos():
     """Replica-vs-primary equivalence under an active KCP_FAULTS
     schedule (ship stream deaths + apply faults + watch drops): the
