@@ -24,6 +24,7 @@ from ..apis.scheme import GVR
 from ..store.selectors import LabelSelector
 from ..store.store import ADDED, DELETED, MODIFIED, Event
 from ..utils import errors
+from ..utils.trace import REGISTRY
 from .client import Client
 
 log = logging.getLogger(__name__)
@@ -83,6 +84,12 @@ class Informer:
         self._stopping = False
         self.rewatch_backoff = 0.2  # reflector retry pacing on stream loss
         self.retry_after_cap = 30.0  # ceiling on server Retry-After hints
+        # resume point: the highest RV this informer has OBSERVED —
+        # advanced by delivered events and, crucially, by server
+        # BOOKMARKs absorbed into the watch's last_rv (no handler wakes,
+        # no resync) — so a stream dropped after a quiet period resumes
+        # inside the watch window instead of relisting the world
+        self._rv = 0
 
     def _retry_delay(self, err: BaseException | None) -> float:
         """Reflector retry pacing: the flat rewatch backoff, unless the
@@ -183,6 +190,7 @@ class Informer:
         items, rv = self.client.list(self.gvr, self.namespace, self.selector)
         for obj in items:
             self._apply(ADDED, obj)
+        self._rv = max(self._rv, rv)
         self._watch = self.client.watch(
             self.gvr, self.namespace, self.selector, since_rv=rv
         )
@@ -192,37 +200,80 @@ class Informer:
             self._resync_task = asyncio.create_task(self._resync_loop())
 
     async def _pump(self) -> None:
-        """Dispatch watch events; on unexpected stream end, re-list + re-watch.
+        """Dispatch watch events; on stream end, resume or re-list.
 
         The reflector loop of client-go: an in-process store Watch only
-        ends when closed, but a REST watch ends on connection drop or an
-        expired watch window (410). Without this, an HTTP-connected
-        controller would silently run against a frozen cache forever.
+        ends when closed, but a REST watch ends on connection drop, an
+        eviction, or an expired watch window (410). A dropped stream
+        first tries a FAST RESUME — re-watch from the highest observed
+        RV (events + absorbed bookmarks), no relist — so a reconnect
+        storm of N informers costs N window resumes served from the
+        store's shared watch-cache index, not N full lists. A 410 (the
+        window really is gone, or we were evicted) or a fast resume that
+        delivers nothing re-lists, exactly as before.
         """
         assert self._watch is not None
         delay = self.rewatch_backoff
+        fast_budget = 1
         while True:
+            delivered = 0
+            err: BaseException | None = None
             try:
                 async for ev in self._watch:
                     self._dispatch(ev)
+                    if ev.rv:
+                        self._rv = max(self._rv, ev.rv)
+                    delivered += 1
                 delay = self.rewatch_backoff
-            except Exception as err:  # noqa: BLE001 — expired window / transport error
+            except Exception as e:  # noqa: BLE001 — expired window / transport error
+                err = e
                 delay = self._retry_delay(err)
-                log.warning("informer %s: watch failed; re-listing in %.2fs",
+                log.warning("informer %s: watch failed; resuming in %.2fs",
                             self.gvr, delay, exc_info=True)
+            # BOOKMARK progress markers advanced the stream's last_rv
+            # without waking any handler — absorb them into the resume
+            # point here, once, at stream end
+            self._rv = max(self._rv, getattr(self._watch, "last_rv", 0) or 0)
             if self._stopping:
                 return
-            await asyncio.sleep(delay)
+            if delivered:
+                fast_budget = 1
+            use_fast = (fast_budget > 0 and self._rv > 0
+                        and not isinstance(err, errors.GoneError))
+            if err is not None or not (use_fast and delivered):
+                await asyncio.sleep(delay)
             try:
-                rv = self._relist()
-                self._watch = self.client.watch(
-                    self.gvr, self.namespace, self.selector, since_rv=rv)
+                if use_fast:
+                    # resume from where the stream left off: no relist,
+                    # no cache churn — the server replays (since_rv, now]
+                    # from its watch window or answers a 410 we turn
+                    # into a relist on the next lap
+                    fast_budget -= 1
+                    self._watch = self.client.watch(
+                        self.gvr, self.namespace, self.selector,
+                        since_rv=self._rv)
+                    REGISTRY.counter(
+                        "informer_fast_resumes_total",
+                        "dropped informer streams resumed from the last "
+                        "observed RV without a relist").inc()
+                else:
+                    rv = self._relist()
+                    self._rv = max(self._rv, rv)
+                    self._watch = self.client.watch(
+                        self.gvr, self.namespace, self.selector,
+                        since_rv=rv)
+                    fast_budget = 1
                 delay = self.rewatch_backoff
-            except Exception as err:  # noqa: BLE001 — server down or shedding load
-                # an overloaded frontend's 429 hint paces the next lap
-                delay = self._retry_delay(err)
-                log.warning("informer %s: re-list failed; retrying in %.2fs",
-                            self.gvr, delay, exc_info=True)
+            except Exception as err2:  # noqa: BLE001 — server down or shedding load
+                # an overloaded frontend's 429 hint paces the next lap;
+                # a 410 on the fast resume falls through to a relist
+                if isinstance(err2, errors.GoneError):
+                    fast_budget = 0
+                delay = self._retry_delay(err2)
+                log.warning("informer %s: %s failed; retrying in %.2fs",
+                            self.gvr,
+                            "fast resume" if use_fast else "re-list",
+                            delay, exc_info=True)
 
     def _relist(self) -> int:
         """Fresh list reconciled against the cache (replace semantics)."""

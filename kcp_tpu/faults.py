@@ -37,6 +37,12 @@ Injection points wired in this codebase:
 
     store.put / store.get / store.list / store.delete   store/store.py
     watch                        store Watch + server/rest.py RestWatch
+    watch.evict                  store/store.py Watch._push (``drop`` =
+                                 force-evict the watcher as if its
+                                 bounded queue overflowed: the stream
+                                 ends with a terminal typed 410 and the
+                                 informer relists — the backpressure
+                                 drill)
     rest.request                 server/rest.py RestClient._request
     syncer.apply                 syncer/engine.py applier pool
     device.step                  syncer/core.py FusedBucket.submit/probe
@@ -105,6 +111,7 @@ POINTS = frozenset({
     "store.list",
     "store.delete",
     "watch",
+    "watch.evict",
     "rest.request",
     "syncer.apply",
     "device.step",

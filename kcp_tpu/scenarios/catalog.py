@@ -63,23 +63,34 @@ NOISY_NEIGHBOR = ScenarioSpec(
 
 RECONNECT_STORM = ScenarioSpec(
     name="reconnect-storm",
-    description="Every watch stream in the fleet severed in the same "
-                "instant while writes continue; all observers resume "
-                "from their last RV at once. The retained watch window "
-                "must absorb the storm: zero lost events, zero "
-                "unrecoverable (410) resumes.",
+    description="10,000 watch streams severed in the same instant while "
+                "writes continue; every observer resumes from its last "
+                "RV at once against one server. The shared watch-cache "
+                "window must absorb the storm: zero lost events, zero "
+                "unrecoverable (410) resumes, and drop-to-first-event "
+                "resume latency bounded at p99. Runs against a real "
+                "server SUBPROCESS so the 10k-stream fd bill is split "
+                "across processes (scale 1.0 needs ~10k fds per side).",
     topology="monolith",
-    tenants=6,
-    watchers_per_tenant=4,
-    phases=(Phase("warm", ops_per_tenant=20),
-            Phase("storm", ops_per_tenant=50, action="drop_watchers"),
-            Phase("recover", ops_per_tenant=20)),
-    options={"pace_s": 0.005},
+    topology_args={"proc": True},
+    tenants=20,
+    watchers_per_tenant=500,
+    phases=(Phase("warm", ops_per_tenant=15),
+            Phase("storm", ops_per_tenant=40, action="drop_watchers",
+                  settle_s=1.0),
+            Phase("recover", ops_per_tenant=15, settle_s=1.0)),
+    options={"pace_s": 0.01, "coverage_timeout_s": 120.0},
     slos=(
         SLO("no-lost-watch-events", "lost_watch_events", "==", 0),
         SLO("no-unrecoverable-resumes", "gone_410", "==", 0),
         SLO("storm-happened", "reconnects", ">=", 1),
-        SLO("convergence", "p99_convergence_ms", "<=", 1500.0),
+        # the bound is the 1-cpu host reality: re-establishing 10k TCP
+        # streams serializes on one accept loop (~500 conns/s), so the
+        # herd converges together near the tail; the SLOs exist to catch
+        # step-function regressions (lost events, 410 storms, resumes
+        # that relist), not millisecond drift
+        SLO("resume-latency", "resume_p99_ms", "<=", 30000.0),
+        SLO("convergence", "p99_convergence_ms", "<=", 30000.0),
         SLO("no-lost-acked-writes", "lost_acked_writes", "==", 0),
         SLO("error-budget-5xx", "http_5xx", "==", 0),
     ),

@@ -200,6 +200,14 @@ def run_crd_tenant(base_url: str, tenant: str, ops, phase_idx: int,
 async def _drive(sspec: ScenarioSpec, seed: int, schedule, topology,
                  stats: WriterStats, measurements: dict) -> list:
     loop = asyncio.get_running_loop()
+    # the default loop executor (cpu+4 threads) is sized for nothing:
+    # every tenant writer occupies a thread for a whole phase, and
+    # observer relists queue BEHIND them — at storm scale that turns a
+    # reconnect into a phase-long stall. Size for writers + relist slack.
+    from concurrent.futures import ThreadPoolExecutor
+
+    loop.set_default_executor(ThreadPoolExecutor(
+        max_workers=sspec.tenants + 32, thread_name_prefix="scenario-io"))
     base = topology.client_url
     observers: list[StreamObserver] = []
     if sspec.workload == "configmaps" and sspec.watchers_per_tenant:
@@ -284,8 +292,13 @@ async def _await_coverage(stats: WriterStats,
     while asyncio.get_running_loop().time() < deadline:
         missing = 0
         for obs in observers:
-            need = want.get(obs.tenant, set())
-            missing += len(need - set(obs.stats.events))
+            need = want.get(obs.tenant)
+            if need:
+                ev = obs.stats.events
+                # membership probes against the live dict — at 10k
+                # observers, rebuilding a set per observer per lap was
+                # the coverage check's own hot loop
+                missing += sum(1 for k in need if k not in ev)
         if missing == 0:
             return
         await asyncio.sleep(0.1)
@@ -322,8 +335,10 @@ def _collect(sspec: ScenarioSpec, stats: WriterStats, observers,
     want = _acked_by_tenant(stats)
     lost_events = 0
     for obs in observers:
-        need = want.get(obs.tenant, set())
-        lost_events += len(need - set(obs.stats.events))
+        need = want.get(obs.tenant)
+        if need:
+            ev = obs.stats.events
+            lost_events += sum(1 for k in need if k not in ev)
     conv: list[float] = []
     obs_by_tenant: dict[str, list[StreamObserver]] = {}
     for obs in observers:
@@ -350,6 +365,13 @@ def _collect(sspec: ScenarioSpec, stats: WriterStats, observers,
     m["gone_410"] = sum(o.stats.gone_410 for o in observers)
     m["relists"] = sum(o.stats.relists for o in observers)
     m["reconnects"] = sum(o.stats.reconnects for o in observers)
+    if observers:
+        resumes = [s for o in observers for s in o.stats.resume_s]
+        # drop→first-event latency across the whole storm; 0.0 when no
+        # deliberate drops happened (the paired `reconnects` SLO guards
+        # a vacuous pass)
+        m["resume_p99_ms"] = round(pctile(resumes, 0.99) * 1000, 3)
+        m["resume_p50_ms"] = round(pctile(resumes, 0.50) * 1000, 3)
     m["p50_convergence_ms"] = round(pctile(conv, 0.50) * 1000, 3)
     m["p99_convergence_ms"] = round(pctile(conv, 0.99) * 1000, 3)
     m["http_5xx"] = stats.http_5xx

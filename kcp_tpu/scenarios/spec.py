@@ -84,6 +84,8 @@ class ScenarioSpec:
         if scale == 1.0:
             return self
         tenants = max(2, math.ceil(self.tenants * scale))
+        watchers = (max(1, math.ceil(self.watchers_per_tenant * scale))
+                    if self.watchers_per_tenant else 0)
         phases = tuple(
             dataclasses.replace(
                 p, ops_per_tenant=(max(4, math.ceil(p.ops_per_tenant * scale))
@@ -93,5 +95,6 @@ class ScenarioSpec:
         for k in ("flood_ops",):
             if k in options:
                 options[k] = max(20, math.ceil(options[k] * scale))
-        return dataclasses.replace(self, tenants=tenants, phases=phases,
-                                   options=options)
+        return dataclasses.replace(self, tenants=tenants,
+                                   watchers_per_tenant=watchers,
+                                   phases=phases, options=options)

@@ -23,11 +23,50 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import os
+import signal
+import subprocess
+import sys
 import time
 from urllib.parse import urlsplit
 
 from ..server.server import Config
 from ..server.threaded import ServerThread
+
+
+def spawn_server(extra_args: list[str] | None = None,
+                 env_overrides: dict | None = None,
+                 timeout: float = 60.0):
+    """Spawn a real ``kcp start`` SUBPROCESS (plaintext, no controllers)
+    and block until it announces its serving address; returns
+    ``(Popen, address)``.
+
+    The out-of-process shape exists for watcher-scale scenarios: a
+    10k-stream storm is 10k fds on each side of the wire, and holding
+    both sides in one process doubles the bill against RLIMIT_NOFILE.
+    The child never imports jax, and engine-side ``KCP_FAULTS``
+    schedules do NOT reach it — subprocess topologies drill client-side
+    and wire-level chaos (drops, storms), not server-internal points."""
+    cmd = [sys.executable, "-m", "kcp_tpu.cli.kcp", "start",
+           "--no-install-controllers", "--no-tls",
+           "--syncer-mode", "none"] + list(extra_args or [])
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("KCP_FAULTS", None)  # engine-phase schedules stay engine-side
+    env["KCP_NO_COMPILE_CACHE"] = "1"
+    env.update({k: str(v) for k, v in (env_overrides or {}).items()})
+    p = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                         stderr=subprocess.DEVNULL, env=env, text=True)
+    deadline = time.time() + timeout
+    while True:
+        line = p.stdout.readline()
+        if not line:
+            raise RuntimeError(
+                f"kcp start exited rc={p.poll()} before serving: {cmd}")
+        if line.startswith("kcp-tpu serving at "):
+            return p, line.rsplit(None, 1)[-1]
+        if time.time() > deadline:
+            p.kill()
+            raise RuntimeError(f"kcp start did not serve in {timeout}s")
 
 
 # ---------------------------------------------------------------------------
@@ -113,19 +152,42 @@ def _env_patch(env: dict):
 
 
 class Monolith:
-    """One server process; controllers optional (CRD scenarios)."""
+    """One server process; controllers optional (CRD scenarios).
+
+    ``proc=True`` runs the server as a real SUBPROCESS instead of a
+    ServerThread — the watcher-scale shape (10k streams = 10k fds per
+    side; one process holding both sides pays double against
+    RLIMIT_NOFILE). Scenario ``env`` reaches the child's environment;
+    engine-side KCP_FAULTS schedules do not (see :func:`spawn_server`).
+    """
 
     kind = "monolith"
 
     def __init__(self, root_dir: str, env: dict | None = None,
-                 durable: bool = False, controllers: bool = False):
+                 durable: bool = False, controllers: bool = False,
+                 proc: bool = False):
         self.root_dir = root_dir
         self.env = env or {}
         self.durable = durable
         self.controllers = controllers
+        self.proc = proc
         self.server: ServerThread | None = None
+        self._child: subprocess.Popen | None = None
+        self._child_url = ""
 
     def start(self) -> "Monolith":
+        if self.proc:
+            if self.controllers:
+                raise ValueError(
+                    "proc=True monolith runs --no-install-controllers; "
+                    "CRD scenarios need the in-process shape")
+            args = ["--listen-port", "0"]
+            if self.durable:
+                args += ["--root-dir", os.path.join(self.root_dir, "mono")]
+            else:
+                args += ["--in-memory"]
+            self._child, self._child_url = spawn_server(args, self.env)
+            return self
         kw: dict = dict(durable=self.durable,
                         install_controllers=self.controllers, tls=False)
         if self.durable:
@@ -136,9 +198,21 @@ class Monolith:
 
     @property
     def client_url(self) -> str:
+        if self._child is not None:
+            return self._child_url
         return self.server.address
 
     def stop(self) -> None:
+        if self._child is not None:
+            # SIGTERM = graceful drain (the CLI's handler); escalate if
+            # the child outlives a generous budget
+            self._child.send_signal(signal.SIGTERM)
+            try:
+                self._child.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                self._child.kill()
+                self._child.wait(timeout=5)
+            self._child = None
         if self.server is not None:
             self.server.stop()
             self.server = None

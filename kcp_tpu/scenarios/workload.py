@@ -278,6 +278,10 @@ class ObserverStats:
     relists: int = 0
     reconnects: int = 0
     last_rv: int = 0
+    # reconnect-storm accounting: seconds from a deliberate drop to the
+    # resumed stream's first delivered event (the client-visible resume
+    # latency the watcher-scale SLO bounds)
+    resume_s: list[float] = field(default_factory=list)
 
 
 class StreamObserver:
@@ -292,6 +296,7 @@ class StreamObserver:
         self.cache: dict[str, dict] = {}
         self._stopping = False
         self._dropped = False
+        self._resume_t0: float | None = None
         self._watch = None
         self._task: asyncio.Task | None = None
         self.synced = asyncio.Event()
@@ -327,12 +332,19 @@ class StreamObserver:
         self.cache = {o["metadata"]["name"]: o for o in items}
         self.stats.last_rv = max(self.stats.last_rv, rv)
         self.stats.relists += 1
+        # fd hygiene at watcher scale: a 10k-observer fleet must not
+        # also pin 10k idle keep-alive list connections — the client
+        # reopens on the next (rare) relist
+        self.client.close()
 
     def _record(self, ev) -> None:
         now = time.monotonic()
         key = (ev.name, ev.rv)
         self.stats.events.setdefault(key, now)
         self.stats.last_rv = max(self.stats.last_rv, ev.rv)
+        if self._resume_t0 is not None:
+            self.stats.resume_s.append(now - self._resume_t0)
+            self._resume_t0 = None
         if ev.type == "DELETED":
             self.cache.pop(ev.name, None)
         else:
@@ -383,9 +395,12 @@ class StreamObserver:
                 self.stats.terminal_statuses += 1
             elif self._dropped:
                 # our own reconnect-storm drop: a deliberate client-side
-                # severing, not a server-side breach
+                # severing, not a server-side breach. The clock on the
+                # resume starts here and stops at the resumed stream's
+                # first delivered event.
                 self._dropped = False
                 self.stats.reconnects += 1
+                self._resume_t0 = time.monotonic()
             elif err is None and not getattr(w, "responded", True):
                 # connect refused (endpoint restarting): not a stream
                 # death, just a failed attempt

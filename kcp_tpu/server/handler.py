@@ -30,10 +30,17 @@ from ..store.store import WILDCARD, LogicalStore
 from ..utils import errors
 from ..utils.routing import resolve_write_cluster
 from ..utils.trace import REGISTRY
-from .httpd import Request, Response, StreamResponse
+from .httpd import FlushCoalescer, Request, Response, StreamResponse
 
 DEFAULT_CLUSTER = "admin"
 CLUSTER_HEADER = "x-kubernetes-cluster"
+
+
+class _SlowWatcher(Exception):
+    """A watch stream fell past KCP_WATCH_BUFFER_MAX on its socket: the
+    coalescer refused further buffering and the producer must end the
+    stream with a terminal typed 410 (the informer relists and resumes
+    — bounded memory beats an unbounded goodbye)."""
 
 
 def _status_body(code: int, reason: str, message: str) -> dict:
@@ -142,6 +149,26 @@ class RestHandler:
         # in-stream Status, and returns — the half of "no watcher is
         # abandoned mid-stream" that the HTTP layer cannot do alone
         self.draining = asyncio.Event()
+        # watcher-scale serving (KCP_WATCH_COALESCE, default on): one
+        # shared flush coalescer gathers every watch stream's encode-once
+        # lines and writes each socket once per coalescing tick —
+        # O(sockets) buffered writes of shared bytes per tick instead of
+        # a write+drain round trip per watcher per event batch. =0 keeps
+        # the per-batch send_raw_many path for A/B (bench.py --watchers).
+        self._coalescer = None
+        if os.environ.get("KCP_WATCH_COALESCE", "1").lower() not in (
+                "0", "false", "off"):
+            self._coalescer = FlushCoalescer(
+                tick_s=float(os.environ.get("KCP_WATCH_FLUSH_MS", "2"))
+                / 1000.0,
+                buffer_max=int(os.environ.get(
+                    "KCP_WATCH_BUFFER_MAX", str(2 * 1024 * 1024))))
+        # per-server bookmark cadence (KCP_WATCH_BOOKMARK_S): how often
+        # an idle stream that asked for bookmarks gets a progress marker
+        # at the store RV — what keeps a quiet informer's resume point
+        # inside the watch window across stream drops
+        self._bookmark_every = float(
+            os.environ.get("KCP_WATCH_BOOKMARK_S", "5"))
 
     async def _st(self, fn, *args, **kwargs):
         """Run a store call; offloaded to the I/O pool for remote stores."""
@@ -778,6 +805,19 @@ class RestHandler:
 
     # -------------------------------------------------------------- watch
 
+    @staticmethod
+    def _send_evicted(stream, message: str) -> None:
+        """Buffer a terminal typed 410 on an evicted stream WITHOUT a
+        drain — the socket may be exactly the full buffer eviction is
+        punishing; close flushes what the client still reads."""
+        line = (json.dumps({"type": "ERROR",
+                            "object": _status_body(410, "Expired", message)})
+                .encode() + b"\n")
+        try:
+            stream.write_raw_many([line])
+        except (AttributeError, ConnectionError, RuntimeError):
+            pass  # duck-typed test stream or torn-down transport
+
     def _watch(self, req: Request, cluster: str, res: str,
                namespace: str | None) -> StreamResponse:
         selector = parse_selector(req.param("labelSelector"))
@@ -800,10 +840,10 @@ class RestHandler:
                 f"timeoutSeconds must be a finite non-negative number, "
                 f"got {timeout_s!r}")
         bookmarks = req.param("allowWatchBookmarks") in ("true", "1")
-        # bookmark cadence: frequent enough that resuming clients lose
-        # little window, cheap enough to be noise (apiserver uses ~1/min;
-        # our watch windows are smaller)
-        bookmark_every = 5.0
+        # bookmark cadence (KCP_WATCH_BOOKMARK_S): frequent enough that
+        # resuming clients lose little window, cheap enough to be noise
+        # (apiserver uses ~1/min; our watch windows are smaller)
+        bookmark_every = self._bookmark_every
 
         async def produce(stream: StreamResponse) -> None:
             try:
@@ -845,7 +885,19 @@ class RestHandler:
                     t0 = loop.time()
                     lines = self.store.encode_events(batch)
                     self._enc_seconds.observe(loop.time() - t0)
-                    await send_raw(lines)
+                    if (self._coalescer is not None
+                            and getattr(stream, "write_raw_many", None)
+                            is not None):
+                        # batched flush: lines park with every other
+                        # stream's and each socket is written once per
+                        # coalescing tick; False = this socket is past
+                        # the buffer bound — evict, don't buffer more.
+                        # Duck-typed streams without the buffered write
+                        # half (test sinks) keep the direct path.
+                        if not await self._coalescer.write(stream, lines):
+                            raise _SlowWatcher()
+                    else:
+                        await send_raw(lines)
                 elif send_many is not None:
                     await send_many(
                         [{"type": e.type, "object": e.object} for e in batch])
@@ -942,6 +994,17 @@ class RestHandler:
                                                        err.message)})
                             return
                         if isinstance(err, StopAsyncIteration):
+                            if getattr(watch, "evicted", False):
+                                # backpressure eviction (KCP_WATCH_QUEUE
+                                # overflow or the watch.evict drill): a
+                                # typed in-stream 410 — the informer
+                                # relists NOW and resumes; the metric
+                                # was counted at the eviction site
+                                self._send_evicted(
+                                    stream,
+                                    "watch queue overflowed "
+                                    "(KCP_WATCH_QUEUE): slow watcher "
+                                    "evicted; re-list and resume")
                             return
                         raise err
                     if ev is not None:
@@ -977,6 +1040,16 @@ class RestHandler:
                             "object": {"kind": "Bookmark", "metadata": {
                                 "resourceVersion": str(rv_now)}},
                         })
+            except _SlowWatcher:
+                # the socket sat past KCP_WATCH_BUFFER_MAX: terminal
+                # typed 410 buffered without a drain (draining a full
+                # slow socket is exactly what eviction exists to avoid)
+                REGISTRY.counter("watch_evicted_total").inc()
+                self._send_evicted(
+                    stream,
+                    "watch socket backlog exceeded KCP_WATCH_BUFFER_MAX: "
+                    "slow watcher evicted; re-list and resume")
+                return
             finally:
                 # reap outstanding helper tasks without awaiting (this
                 # block also runs under cancellation): the callback

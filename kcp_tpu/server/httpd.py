@@ -18,9 +18,27 @@ from dataclasses import dataclass, field
 from typing import Awaitable, Callable
 from urllib.parse import parse_qs, unquote, urlsplit
 
+from ..utils.trace import REGISTRY, SIZE_BUCKETS
+
 log = logging.getLogger(__name__)
 
+#: stream flush operations — one buffered chunk write (plus, on the
+#: non-coalesced paths, its drain round trip) per socket. The watcher-
+#: scale A/B (`bench.py --watchers`) reads the per-fan-out reduction off
+#: this counter.
+_FLUSHES = REGISTRY.counter(
+    "watch_flush_total",
+    "watch-stream flush operations (one chunk write per socket)")
+_FLUSH_BATCH = REGISTRY.histogram(
+    "watch_flush_batch_size",
+    "event lines merged into one stream flush", buckets=SIZE_BUCKETS)
+
 MAX_HEADER_BYTES = 64 * 1024
+# listener accept backlog: a 10k-watcher reconnect storm lands thousands
+# of TCP connects in the same instant — the asyncio default (100) would
+# refuse most of the herd and stretch resume latency by retry round
+# trips (kernel still caps at net.core.somaxconn)
+LISTEN_BACKLOG = int(os.environ.get("KCP_LISTEN_BACKLOG", "4096"))
 # request-body ceiling (KCP_MAX_BODY_BYTES): the cheapest admission
 # control of all — a declared body over the limit is refused 413 before
 # a single payload byte is buffered. 3 MiB default ~= the apiserver's
@@ -113,6 +131,8 @@ class StreamResponse:
             return
         data = b"".join(json.dumps(o).encode() + b"\n" for o in objs)
         self._writer.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+        _FLUSHES.inc()
+        _FLUSH_BATCH.observe(len(objs))
         await self._writer.drain()
 
     async def send_raw_many(self, lines) -> None:
@@ -124,9 +144,36 @@ class StreamResponse:
         assert self._writer is not None
         if not lines:
             return
+        self.write_raw_many(lines)
+        await self._writer.drain()
+
+    def write_raw_many(self, lines) -> None:
+        """Frame pre-encoded lines as ONE chunk and buffer them on the
+        transport WITHOUT draining — the :class:`FlushCoalescer`'s write
+        half. Backpressure is handled by eviction (the coalescer checks
+        the transport buffer against ``KCP_WATCH_BUFFER_MAX``), never by
+        awaiting a slow socket."""
+        assert self._writer is not None
+        if not lines:
+            return
+        tr = self._writer.transport
+        if tr is None or tr.is_closing():
+            raise ConnectionResetError("stream transport closed")
         data = b"".join(lines)
         self._writer.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
-        await self._writer.drain()
+        _FLUSHES.inc()
+        _FLUSH_BATCH.observe(len(lines))
+
+    def write_buffer_size(self) -> int:
+        """Bytes buffered on this stream's transport — the slow-client
+        signal the coalescer's eviction policy reads."""
+        w = self._writer
+        if w is None or w.transport is None:
+            return 0
+        try:
+            return w.transport.get_write_buffer_size()
+        except Exception:  # noqa: BLE001 — transport torn down mid-call
+            return 0
 
     async def _finish(self) -> None:
         if self._writer is not None:
@@ -135,6 +182,64 @@ class StreamResponse:
                 await self._writer.drain()
             except (ConnectionError, RuntimeError):
                 pass
+
+
+class FlushCoalescer:
+    """Batches watch-stream writes across many sockets into one
+    event-loop pass (``KCP_WATCH_COALESCE``).
+
+    Producers call ``await write(stream, lines)``; lines park per-stream
+    and the whole map flushes after one coalescing tick
+    (``KCP_WATCH_FLUSH_MS``): each socket gets ONE joined chunk write
+    per tick no matter how many event batches accumulated, so a
+    sustained fan-out to N watchers costs O(sockets) buffered writes of
+    shared encode-once bytes per tick instead of O(batches × watchers)
+    write+drain round trips.
+
+    Backpressure is by eviction, not drain: the flush never awaits a
+    slow socket. A stream whose transport buffer exceeds ``buffer_max``
+    (``KCP_WATCH_BUFFER_MAX``) resolves its producer's future ``False``
+    — the producer ends the stream with a terminal typed 410 and the
+    informer's relist-NOW path takes over. Everyone else's tick is never
+    held hostage by the slowest reader.
+    """
+
+    def __init__(self, tick_s: float = 0.002,
+                 buffer_max: int = 2 * 1024 * 1024):
+        self.tick_s = tick_s
+        self.buffer_max = buffer_max
+        self._pending: dict[StreamResponse,
+                            tuple[list[bytes], asyncio.Future]] = {}
+        self._scheduled = False
+
+    def write(self, stream: StreamResponse, lines) -> "asyncio.Future[bool]":
+        """Park ``lines`` for ``stream``; the returned future resolves
+        True once flushed (False = over the buffer bound: evict)."""
+        loop = asyncio.get_running_loop()
+        ent = self._pending.get(stream)
+        if ent is None:
+            ent = self._pending[stream] = ([], loop.create_future())
+        ent[0].extend(lines)
+        if not self._scheduled:
+            self._scheduled = True
+            if self.tick_s > 0:
+                loop.call_later(self.tick_s, self._flush)
+            else:
+                loop.call_soon(self._flush)
+        return ent[1]
+
+    def _flush(self) -> None:
+        self._scheduled = False
+        pending, self._pending = self._pending, {}
+        for stream, (lines, fut) in pending.items():
+            if fut.done():
+                continue  # producer cancelled (client went away)
+            try:
+                stream.write_raw_many(lines)
+            except Exception as e:  # noqa: BLE001 — surfaced to the producer
+                fut.set_exception(e)
+                continue
+            fut.set_result(stream.write_buffer_size() <= self.buffer_max)
 
 
 _REASONS = {200: "OK", 201: "Created", 400: "Bad Request", 403: "Forbidden",
@@ -172,7 +277,8 @@ class HttpServer:
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
-            self._serve, self.host, self.port, ssl=self.ssl_context)
+            self._serve, self.host, self.port, ssl=self.ssl_context,
+            backlog=LISTEN_BACKLOG)
         self.port = self._server.sockets[0].getsockname()[1]
         log.info("http%s server listening on %s:%d",
                  "s" if self.ssl_context else "", self.host, self.port)

@@ -539,13 +539,21 @@ class RestClient:
 
     def watch(self, gvr: GVR | str, namespace: str | None = None,
               selector: LabelSelector | None = None,
-              since_rv: int | None = None) -> RestWatch:
+              since_rv: int | None = None,
+              bookmarks: bool = True) -> RestWatch:
+        """Open a watch stream. ``bookmarks`` (default on, KEP-1904
+        style) asks the server for periodic BOOKMARK progress markers:
+        RestWatch absorbs them into ``last_rv`` without yielding, so a
+        stream dropped after a quiet period resumes from a fresh RV
+        inside the watch window instead of 410ing into a relist."""
         res = self._resource_name(gvr)
         query = "watch=true"
         if selector is not None and not selector.empty:
             query += "&labelSelector=" + quote(str(selector))
         if since_rv is not None:
             query += f"&resourceVersion={since_rv}"
+        if bookmarks:
+            query += "&allowWatchBookmarks=true"
         path = self._path(res, namespace, query=query)
         return RestWatch(self._host, self._port, path, res, token=self.token,
                          ssl_context=self._ssl)

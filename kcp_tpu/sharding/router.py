@@ -165,6 +165,11 @@ class RouterHandler:
             "router_replica_fallback_total",
             "replica reads that fell back to the primary (replica "
             "unreachable or refusing)")
+        self._watch_spread = REGISTRY.counter(
+            "router_watch_spread_total",
+            "fresh single-cluster watch streams the router spread onto "
+            "a shard's read replica (watch connection capacity scaling "
+            "with replica count)")
         # promotion discovery: repeated 503/unreachable answers from a
         # shard's primary trigger a probe of the shard's replica list;
         # a replica answering /replication/status as role=primary is the
@@ -342,17 +347,22 @@ class RouterHandler:
 
     def _replica_watch_pool(self, idx: int,
                             req: Request) -> ConnectionPool | None:
-        """A replica pool for a FRESH single-cluster watch (no resume
-        RV): the replica's stream is its own honest sequence. Resumes
-        carry an RV the client got from a primary-coherent read, so
-        they stay on the primary (a lagging replica would answer 410
-        beyond its applied RV — correct, but a needless relist)."""
+        """Where a FRESH single-cluster watch stream lands: fresh
+        watches (no resume RV) round-robin across the shard's primary
+        AND its replicas, so live watch connection count scales with
+        the replica count — a replica's stream is its own honest RV
+        sequence. Resumes carry an RV the client got from a
+        primary-coherent read, so they stay on the primary (a lagging
+        replica would answer 410 beyond its applied RV via
+        ``reject_future_rv`` — correct, but a needless relist)."""
         pools = self._rpools[idx]
         if not pools or req.param("resourceVersion"):
             return None
-        j = self._rr[idx] % len(pools)
-        self._rr[idx] = (j + 1) % len(pools)
-        self._replica_reads.inc()
+        j = self._rr[idx] % (len(pools) + 1)
+        self._rr[idx] = (j + 1) % (len(pools) + 1)
+        if j == len(pools):
+            return None  # the primary's turn in the rotation
+        self._watch_spread.inc()
         return pools[j]
 
     async def _scatter(self, method: str, target: str,

@@ -65,6 +65,21 @@ Encode-once serving (KCP_ENCODE_CACHE=1, the default, indexed stores):
   for A/B (``bench.py --encode``), and the ``encode.cache`` KCP_FAULTS
   point force-drops cached entries to exercise the re-encode fallback.
 
+Watcher scale (PR 11):
+
+- the retained history is the **watch-cache window** (``KCP_WATCH_WINDOW``
+  events) with a bisect-able shared index: a resume is one binary search
+  plus a suffix replay of shared Event instances (so the encode-once wire
+  bytes are shared across every resumer of a reconnect storm);
+- per-watcher queues are **bounded** (``KCP_WATCH_QUEUE``): a consumer
+  that stops draining is EVICTED — ``Watch.evicted`` set, stream closed —
+  and the HTTP relay turns that into a terminal in-stream typed 410 so
+  informers relist-NOW and resume (the ``watch.evict`` fault point drills
+  the path);
+- the fan-out keeps a per-resource watch index with cached scope/selector
+  arrays (rebuilt only when the watch set changes), so a flush is
+  O(events + deliveries), not O(live watchers).
+
 Thread-model: single-threaded synchronous core intended to be called from
 one asyncio event loop; watches buffer into deques and optionally notify an
 asyncio.Event so async consumers can await new events.
@@ -109,6 +124,21 @@ def _env_indexed() -> bool:
 
 def _env_encode_cache() -> bool:
     return os.environ.get("KCP_ENCODE_CACHE", "1").lower() not in ("0", "false", "off")
+
+
+def _env_watch_window() -> int:
+    """Retained watch-cache window (events): how far back a
+    ``watch(since_rv=...)`` resume can reach before answering 410."""
+    return int(os.environ.get("KCP_WATCH_WINDOW", "200000"))
+
+
+def _env_watch_queue() -> int:
+    """Per-watcher event-queue bound (0 = unbounded, the legacy
+    behavior). A watcher whose consumer stops draining past the bound is
+    EVICTED — closed with ``Watch.evicted`` set, which the HTTP relay
+    turns into a terminal in-stream typed 410 (informers relist-NOW and
+    resume) — instead of buffering the window into unbounded memory."""
+    return int(os.environ.get("KCP_WATCH_QUEUE", "65536"))
 
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
@@ -155,6 +185,13 @@ class Watch:
         self.selector = selector
         self._events: deque[Event] = deque()
         self._closed = False
+        # backpressure policy (KCP_WATCH_QUEUE): a consumer that stops
+        # draining past the bound gets evicted instead of pinning the
+        # window in unbounded per-watcher memory; `evicted` tells the
+        # serving layer to end the stream with a typed 410 rather than
+        # a silent close
+        self._max_queue = store._watch_queue
+        self.evicted = False
         self._wakeup: asyncio.Event | None = None
         # batched fan-out (indexed stores): a single-equality selector
         # matches via one interned pair id (the fanout_match shape), a
@@ -212,9 +249,31 @@ class Watch:
             # dropped connection — consumers must re-list (informers do)
             self.close()
             return
+        if should_drop("watch.evict") or (
+                self._max_queue and len(self._events) >= self._max_queue):
+            # queue overflow (or an injected eviction drill): this
+            # consumer is too slow to keep its seat — evict it rather
+            # than buffer without bound. The event is NOT appended: the
+            # stream ends with a typed 410 and the client relists.
+            self._evict()
+            return
         self._events.append(ev)
+        depth = len(self._events)
+        if depth >= 64 and depth & (depth - 1) == 0:
+            # sampled at powers of two: queue depth visibility without a
+            # histogram transaction on every push of the hot path
+            self._store._queue_depth.observe(depth)
         if self._wakeup is not None:
             self._wakeup.set()
+
+    def _evict(self) -> None:
+        self.evicted = True
+        self._store._evicted_total.inc()
+        log.warning(
+            "watch %s/%s evicted: consumer fell %d events behind "
+            "(KCP_WATCH_QUEUE=%d)", self.resource, self.cluster,
+            len(self._events), self._max_queue)
+        self.close()
 
     def drain(self) -> list[Event]:
         """Return and clear all buffered events (sync consumers/tests)."""
@@ -408,7 +467,28 @@ class LogicalStore:
         self._objects: dict[Key, dict] = {}
         self._rv = 0
         self._watches: list[Watch] = []
-        self._history: deque[Event] = deque(maxlen=200_000)
+        # watch hub index: resource -> live watches, maintained on
+        # subscribe/unsubscribe with a version stamp per resource so the
+        # fan-out's per-watch scope/selector arrays are built once per
+        # watch-set change, not once per flush (at 10k watchers the
+        # per-flush rebuild WAS the fan-out cost)
+        self._watches_by_res: dict[str, list[Watch]] = {}
+        self._watch_ver: dict[str, int] = {}
+        self._fanout_cache: dict[str, tuple] = {}
+        # the watch-cache window (KCP_WATCH_WINDOW events): both the
+        # resume source and the bound on how far back since_rv may reach
+        self._history: deque[Event] = deque(maxlen=_env_watch_window())
+        # shared resume window: a bisect-able mirror of _history (event
+        # refs + their rvs, compacted lazily) so a reconnect storm of N
+        # watchers resuming from nearby rvs costs N binary searches over
+        # ONE shared index instead of N independent tail-scans. The
+        # mirror self-heals against direct _history surgery (tests shrink
+        # or swap the deque): a cheap end-identity check at resume time
+        # rebuilds it when out of sync.
+        self._hist_events: list[Event] = []
+        self._hist_rvs: list[int] = []
+        self._hist_start = 0
+        self._watch_queue = _env_watch_queue()
         self._clock = clock
         self._indexed = _env_indexed() if indexed is None else bool(indexed)
         # secondary index: resource -> cluster -> namespace -> {key: obj};
@@ -453,6 +533,24 @@ class LogicalStore:
         self._enc_shared = REGISTRY.counter(
             "encode_cache_bytes_shared_total",
             "response bytes served from cached encodings")
+        self._resume_shared = REGISTRY.counter(
+            "watch_resume_shared_total",
+            "watch resumes answered from the shared in-sync window index "
+            "(one bisect, no per-watcher history scan)")
+        self._evicted_total = REGISTRY.counter(
+            "watch_evicted_total",
+            "watchers evicted for falling behind (per-watcher queue "
+            "overflow or socket buffer past KCP_WATCH_BUFFER_MAX)")
+        self._queue_depth = REGISTRY.histogram(
+            "watch_queue_depth",
+            "per-watcher buffered events, sampled at powers of two >= 64",
+            buckets=SIZE_BUCKETS)
+        # global cluster/namespace interning for the fan-out scope
+        # matrices: ids are stable across batches, so the per-watch
+        # scope arrays can be cached per watch-set version instead of
+        # re-interned against every batch
+        self._intern_cl: dict[str, int] = {}
+        self._intern_ns: dict[str, int] = {}
         self._wal: _WalConfig | None = None
         self._engine = None
         self._engine_mutations = 0
@@ -1099,21 +1197,58 @@ class LogicalStore:
                 raise GoneError(
                     f"watch window expired: requested rv {since_rv}, oldest retained {oldest}"
                 )
-            # reversed tail-scan: resume RVs are recent (informers resume
-            # from where their stream dropped), so walk back from the end
-            # and replay the suffix — O(events replayed), instead of
-            # scanning the whole 200k-event retention from the front
-            tail: list[Event] = []
-            for ev in reversed(self._history):
-                if ev.rv <= since_rv:
-                    break
-                tail.append(ev)
-            for ev in reversed(tail):
+            # shared window resume: one bisect over the window's rv index
+            # (shared by every resuming watcher — a 10k-watcher reconnect
+            # storm costs 10k binary searches over ONE index, not 10k
+            # history scans), replaying the suffix through the watch's
+            # own selector transform. The replayed Event objects are the
+            # window's own instances, so the encode-once wire bytes are
+            # shared across every resumer too.
+            for ev in self._resume_slice(since_rv):
                 out = w._transform(ev)
                 if out is not None:
                     w._push(out)
-        self._watches.append(w)
+        if not w._closed:
+            # an injected drop/evict during replay already closed (and
+            # unregistered) the watch — registering it would leak a dead
+            # entry in the hub index
+            self._watches.append(w)
+            self._watches_by_res.setdefault(resource, []).append(w)
+            self._watch_ver[resource] = self._watch_ver.get(resource, 0) + 1
         return w
+
+    def _resume_slice(self, since_rv: int) -> list[Event]:
+        """The window events with rv > since_rv, from the shared mirror
+        index (rebuilt only when direct history surgery desynced it)."""
+        from bisect import bisect_right
+
+        h = self._history
+        es, rs, start = self._hist_events, self._hist_rvs, self._hist_start
+        live = len(es) - start
+        if (live == len(h) and live > 0
+                and es[start] is h[0] and es[-1] is h[-1]):
+            self._resume_shared.inc()
+        else:
+            # out of sync (tests swap/shrink the deque; resyncs clear it):
+            # rebuild the mirror from the deque once, then bisect
+            es = self._hist_events = list(h)
+            rs = self._hist_rvs = [e.rv for e in es]
+            start = self._hist_start = 0
+        return es[bisect_right(rs, since_rv, start):]
+
+    def _note_history(self, ev: Event) -> None:
+        """Mirror one appended history event into the shared resume
+        index; trims to the deque's live length and compacts lazily."""
+        es, rs = self._hist_events, self._hist_rvs
+        es.append(ev)
+        rs.append(ev.rv)
+        excess = (len(es) - self._hist_start) - len(self._history)
+        if excess > 0:
+            self._hist_start += excess
+            if self._hist_start > 65536:
+                del es[:self._hist_start]
+                del rs[:self._hist_start]
+                self._hist_start = 0
 
     def _emit(self, etype: str, key: Key, obj: dict, rv: int, old: dict | None = None) -> None:
         if not self._indexed:
@@ -1122,6 +1257,7 @@ class LogicalStore:
                 copy.deepcopy(old) if old is not None else None,
             )
             self._history.append(ev)
+            self._note_history(ev)
             # snapshot: an injected watch drop closes (and unsubscribes)
             # the watch from inside _push, mid-iteration
             for w in list(self._watches):
@@ -1134,6 +1270,7 @@ class LogicalStore:
         # per-event double deepcopy of the legacy path is gone
         ev = Event(etype, key[0], key[1], key[2], key[3], obj, rv, old)
         self._history.append(ev)
+        self._note_history(ev)
         self._pending.append(ev)
         if len(self._pending) >= self._emit_batch:
             self._flush_events()
@@ -1176,15 +1313,52 @@ class LogicalStore:
         by_res: dict[str, list[Event]] = {}
         for ev in batch:
             by_res.setdefault(ev.resource, []).append(ev)
-        w_by_res: dict[str, list[Watch]] = {}
-        for w in self._watches:
-            w_by_res.setdefault(w.resource, []).append(w)
         for res, evs in by_res.items():
-            ws = [w for w in w_by_res.get(res, ()) if not w._closed]
-            if ws:
-                self._fanout_resource(evs, ws)
+            if self._watches_by_res.get(res):
+                self._fanout_resource(res, evs)
 
-    def _fanout_resource(self, evs: list[Event], ws: list[Watch]) -> None:
+    def _cid(self, cluster: str) -> int:
+        i = self._intern_cl.get(cluster)
+        if i is None:
+            i = self._intern_cl[cluster] = len(self._intern_cl)
+        return i
+
+    def _nid(self, namespace: str) -> int:
+        i = self._intern_ns.get(namespace)
+        if i is None:
+            i = self._intern_ns[namespace] = len(self._intern_ns)
+        return i
+
+    def _fanout_plan(self, res: str):
+        """The per-resource fan-out plan — the watch partition plus the
+        per-watch scope/selector arrays — cached per watch-set version.
+        Rebuilding this per flush was O(watches) python per mutation
+        batch; at 10k live watchers the cache makes a flush O(events +
+        deliveries) with the [N, C] algebra in numpy."""
+        ver = self._watch_ver.get(res, 0)
+        plan = self._fanout_cache.get(res)
+        if plan is not None and plan[0] == ver:
+            return plan
+        ws = [w for w in self._watches_by_res.get(res, ()) if not w._closed]
+        fb_ws = [w for w in ws if not w.selector.empty
+                 and w._eq_pid is None and w._compiled is None]
+        mx_ws = [w for w in ws if w.selector.empty
+                 or w._eq_pid is not None or w._compiled is not None]
+        w_cl = np.array([-2 if w.cluster == WILDCARD
+                         else self._cid(w.cluster) for w in mx_ws], np.int32)
+        w_ns = np.array([-2 if w.namespace is None
+                         else self._nid(w.namespace) for w in mx_ws], np.int32)
+        eq_cols = [ci for ci, w in enumerate(mx_ws) if w._eq_pid is not None]
+        gen_cols = [ci for ci, w in enumerate(mx_ws) if w._compiled is not None]
+        empty_cols = [ci for ci, w in enumerate(mx_ws) if w.selector.empty]
+        sels = (np.array([mx_ws[ci]._eq_pid for ci in eq_cols], np.uint32)
+                if eq_cols else None)
+        plan = (ver, mx_ws, fb_ws, w_cl, w_ns, eq_cols, gen_cols,
+                empty_cols, sels)
+        self._fanout_cache[res] = plan
+        return plan
+
+    def _fanout_resource(self, res: str, evs: list[Event]) -> None:
         """One resource's events x that resource's watches, as matrices.
 
         Selector matching is one vectorized pass over interned label ids:
@@ -1193,28 +1367,20 @@ class LogicalStore:
         exact per-event python path. Scope and the old-match/new-match
         ADDED/MODIFIED/DELETED rewrite of :meth:`Watch._transform` are
         then [N, C] boolean algebra; python touches only the (sparse)
-        deliveries.
+        deliveries. Per-watch arrays come from the cached fan-out plan.
         """
         n = len(evs)
-        fb_ws = [w for w in ws
-                 if not w.selector.empty and w._eq_pid is None and w._compiled is None]
-        mx_ws = [w for w in ws if w not in fb_ws]
+        (_ver, mx_ws, fb_ws, w_cl, w_ns, eq_cols, gen_cols, empty_cols,
+         sels) = self._fanout_plan(res)
         if mx_ws:
             c = len(mx_ws)
-            # scope[N, C]: cluster/namespace ids interned per batch;
-            # watch values absent from the batch get -1 (match nothing),
-            # wildcards -2 (match everything)
-            cmap: dict[str, int] = {}
-            nmap: dict[str, int] = {}
-            cl_ids = np.empty(n, np.int32)
-            ns_ids = np.empty(n, np.int32)
-            for i, ev in enumerate(evs):
-                cl_ids[i] = cmap.setdefault(ev.cluster, len(cmap))
-                ns_ids[i] = nmap.setdefault(ev.namespace, len(nmap))
-            w_cl = np.array([-2 if w.cluster == WILDCARD
-                             else cmap.get(w.cluster, -1) for w in mx_ws], np.int32)
-            w_ns = np.array([-2 if w.namespace is None
-                             else nmap.get(w.namespace, -1) for w in mx_ws], np.int32)
+            # scope[N, C]: cluster/namespace ids from the store-global
+            # intern tables (stable across batches, so the w_cl/w_ns
+            # arrays are cached in the plan); wildcards are -2
+            cl_ids = np.fromiter((self._cid(ev.cluster) for ev in evs),
+                                 np.int32, n)
+            ns_ids = np.fromiter((self._nid(ev.namespace) for ev in evs),
+                                 np.int32, n)
             scope = ((w_cl[None, :] == -2) | (cl_ids[:, None] == w_cl[None, :])) \
                 & ((w_ns[None, :] == -2) | (ns_ids[:, None] == w_ns[None, :]))
 
@@ -1224,24 +1390,20 @@ class LogicalStore:
 
             nm = np.zeros((n, c), bool)
             om = np.zeros((n, c), bool)
-            eq_cols = [ci for ci, w in enumerate(mx_ws) if w._eq_pid is not None]
-            gen_cols = [ci for ci, w in enumerate(mx_ws) if w._compiled is not None]
             if eq_cols or gen_cols:
                 from ..ops import labelmatch as lm
 
                 pair_new, key_new = self._encode_labels(evs, old=False)
                 pair_old, key_old = self._encode_labels(evs, old=True)
                 if eq_cols:
-                    sels = np.array([mx_ws[ci]._eq_pid for ci in eq_cols], np.uint32)
                     nm[:, eq_cols] = lm.fanout_match_np(pair_new, sels)
                     om[:, eq_cols] = lm.fanout_match_np(pair_old, sels)
                 for ci in gen_cols:
                     cs = mx_ws[ci]._compiled
                     nm[:, ci] = lm.match_batch_np(pair_new, key_new, cs)
                     om[:, ci] = lm.match_batch_np(pair_old, key_old, cs)
-            for ci, w in enumerate(mx_ws):
-                if w.selector.empty:
-                    nm[:, ci] = om[:, ci] = True
+            if empty_cols:
+                nm[:, empty_cols] = om[:, empty_cols] = True
             nm &= ~is_del[:, None]  # _transform: new_match is False on DELETED
 
             as_is = scope & ((is_add[:, None] & nm)
@@ -1350,6 +1512,16 @@ class LogicalStore:
             self._watches.remove(w)
         except ValueError:
             pass
+        ws = self._watches_by_res.get(w.resource)
+        if ws is not None:
+            try:
+                ws.remove(w)
+            except ValueError:
+                return  # never registered (closed during resume replay)
+            if not ws:
+                del self._watches_by_res[w.resource]
+            self._watch_ver[w.resource] = \
+                self._watch_ver.get(w.resource, 0) + 1
 
     # ---------------------------------------------------------- durability
 
@@ -1492,6 +1664,9 @@ class LogicalStore:
         self._objects.clear()
         self._buckets.clear()
         self._history.clear()
+        self._hist_events.clear()
+        self._hist_rvs.clear()
+        self._hist_start = 0
         self._pending.clear()
         self._enc_bytes.clear()
         self._span_cache.clear()

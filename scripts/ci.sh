@@ -215,6 +215,35 @@ print("replica smoke ok: %sx read capacity @2 | lag p99 %sms | kill: %d acked"
          kill["promote_ms"], kill["epoch"]))
 '
 
+echo "== watchers: 1k-stream watcher-scale smoke (bounded RSS, delivery floor, flush A/B, evict drill)"
+# reduced-scale --watchers lane: the server runs in its own child process
+# (fd budget), 1k live streams at 10k objects. Floors: every stream
+# established, bounded per-watcher memory with a soak plateau, a delivery
+# p99 ceiling generous enough for loaded CI hosts, the flush-coalescing
+# A/B byte-identical with a >=4x reduction (13x at the full-scale default
+# tick on an idle host), and the slow-watcher eviction drill green.
+w_line=$(KCP_BENCH_WATCHERS=1000 KCP_BENCH_WATCH_OBJECTS=10000 \
+    KCP_BENCH_WATCH_CLUSTERS=20 KCP_BENCH_WATCH_MUTS=400 \
+    KCP_BENCH_WATCH_AB=48 KCP_BENCH_WATCH_AB_MUTS=300 \
+    python bench.py --watchers | tail -1)
+printf '%s\n' "$w_line" | python -c '
+import json, sys
+r = json.loads(sys.stdin.readline())
+wb = r["watchers_bench"]
+sc, ab, drill = wb["scale"], wb["ab"], wb["evict_drill"]
+assert sc["streams_established"] == sc["watchers"], sc
+assert sc["rss_per_watcher_kb"] < 100, "per-watcher RSS %s kb" % sc["rss_per_watcher_kb"]
+assert sc["rss_soak_growth"] < 1.15, "RSS grew under soak: %s" % sc["rss_soak_growth"]
+assert sc["delivery_p99_ms"] is not None and sc["delivery_p99_ms"] < 3000, sc
+assert ab["bytes_equal"] and ab["lines_equal"], "A/B streams diverged: %s" % ab
+assert r["value"] >= 4.0, "flush reduction %sx < 4x floor" % r["value"]
+assert drill["ok"], "evict drill failed: %s" % drill
+print("watchers smoke ok: %d streams | p99 %sms | %s kb/watcher (soak %s)"
+      " | flush A/B %sx byte-identical | evict drill green"
+      % (sc["streams_established"], sc["delivery_p99_ms"],
+         sc["rss_per_watcher_kb"], sc["rss_soak_growth"], r["value"]))
+'
+
 echo "== scenarios: seeded end-to-end chaos smoke (churn + reconnect storm + kill-the-primary drill)"
 # reduced-scale subset of the scenario harness (scripts/scenarios.py):
 # real topologies over real HTTP, hard SLO floors (zero lost acked

@@ -32,6 +32,15 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 def main(argv=None) -> int:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    try:
+        # watcher-scale scenarios hold one fd per live stream: lift the
+        # soft nofile limit to the hard cap before the storm starts
+        import resource
+
+        _soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+        resource.setrlimit(resource.RLIMIT_NOFILE, (hard, hard))
+    except (ImportError, ValueError, OSError):
+        pass
     p = argparse.ArgumentParser(description=__doc__)
     sub = p.add_subparsers(dest="command", required=True)
     sub.add_parser("list", help="list the named scenarios")

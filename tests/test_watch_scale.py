@@ -1,0 +1,547 @@
+"""Watcher-scale serving: shared resume window, bounded queues +
+eviction, flush coalescing, bookmark-advanced fast resume, and the
+router's watch spread across replicas.
+
+The differential contract under test: a watcher that drops and resumes
+through the shared window (``watch(since_rv=...)`` answered by one
+bisect over the window index) must observe a byte-identical event
+stream to one that never dropped — including through an eviction → 410
+→ relist recovery, which may *re-deliver* but must never *lose*.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import random
+import time
+from collections import deque
+
+import pytest
+
+from kcp_tpu import faults
+from kcp_tpu.apis.scheme import default_scheme
+from kcp_tpu.client.informer import Informer
+from kcp_tpu.server.handler import RestHandler
+from kcp_tpu.server.httpd import HttpServer
+from kcp_tpu.server.rest import RestClient
+from kcp_tpu.store.selectors import parse_selector
+from kcp_tpu.store.store import LogicalStore
+from kcp_tpu.utils import errors
+from kcp_tpu.utils.trace import REGISTRY
+
+
+@pytest.fixture(autouse=True)
+def _clear_faults():
+    yield
+    faults.clear()
+
+
+def _cm(name: str, cluster: str, data: str = "", labels: dict | None = None):
+    meta = {"name": name, "namespace": "default", "clusterName": cluster}
+    if labels:
+        meta["labels"] = labels
+    return {"apiVersion": "v1", "kind": "ConfigMap", "metadata": meta,
+            "data": {"v": data}}
+
+
+# ---------------------------------------------------------------------------
+# shared resume window: differential fuzz vs a never-dropped watcher
+# ---------------------------------------------------------------------------
+
+
+def _drive(store: LogicalStore, rng: random.Random, n: int) -> None:
+    clusters = ["t0", "t1", "t2"]
+    for i in range(n):
+        cl = clusters[rng.randrange(3)]
+        name = f"cm-{rng.randrange(24)}"
+        labels = {"team": f"g{rng.randrange(3)}"}
+        try:
+            if rng.random() < 0.2:
+                store.delete("configmaps", cl, name)
+            elif rng.random() < 0.5:
+                store.update("configmaps", cl,
+                             _cm(name, cl, str(i), labels))
+            else:
+                store.create("configmaps", cl, _cm(name, cl, str(i), labels))
+        except (errors.NotFoundError, errors.AlreadyExistsError):
+            pass
+
+
+@pytest.mark.parametrize("seed", [3, 17, 92])
+def test_window_resume_byte_identical_to_continuous(seed):
+    """Drop/resume through the shared window at random points; the
+    resumed stream's encoded wire lines must be byte-identical to what
+    a continuous watcher saw over the same rv span — for unselected AND
+    selector-bound watches (whose replay runs the label-transition
+    rewrite)."""
+    rng = random.Random(seed)
+    store = LogicalStore()
+    _drive(store, rng, 60)
+
+    for selector in (None, parse_selector("team=g1")):
+        continuous = store.watch("configmaps", selector=selector)
+        seen: list = []
+        resumer = store.watch("configmaps", selector=selector,
+                              since_rv=store.resource_version)
+        for _round in range(6):
+            _drive(store, rng, rng.randrange(5, 40))
+            seen.extend(continuous.drain())
+            # sever + resume from the last rv this watcher observed
+            last = seen[-1].rv if seen else store.resource_version
+            resumer.close()
+            resumer = store.watch("configmaps", selector=selector,
+                                  since_rv=last)
+        seen.extend(continuous.drain())
+        resumed_tail = resumer.drain()
+        # the final resume's replay must equal the continuous stream's
+        # suffix over the same span, byte for byte on the wire
+        span = [ev for ev in seen if ev.rv > (seen[-len(resumed_tail) - 1].rv
+                                              if len(resumed_tail) < len(seen)
+                                              else 0)]
+        assert [store.encode_event(e) for e in resumed_tail] == \
+            [store.encode_event(e) for e in span[-len(resumed_tail):]]
+        continuous.close()
+        resumer.close()
+    store.close()
+
+
+def test_resume_served_from_shared_index_and_survives_history_surgery():
+    store = LogicalStore()
+    for i in range(30):
+        store.create("configmaps", "t0", _cm(f"a{i}", "t0"))
+    before = REGISTRY.counter("watch_resume_shared_total").value
+    w = store.watch("configmaps", since_rv=store.resource_version - 10)
+    assert len(w.drain()) == 10
+    assert REGISTRY.counter("watch_resume_shared_total").value == before + 1
+    w.close()
+
+    # direct history surgery (what tests do to shrink the window): the
+    # mirror must self-heal, honoring the NEW window
+    store._history = deque(store._history, maxlen=8)
+    with pytest.raises(errors.GoneError):
+        store.watch("configmaps", since_rv=store.resource_version - 20)
+    w2 = store.watch("configmaps", since_rv=store.resource_version - 4)
+    assert len(w2.drain()) == 4
+    w2.close()
+    store.close()
+
+
+# ---------------------------------------------------------------------------
+# bounded queues + eviction
+# ---------------------------------------------------------------------------
+
+
+def test_queue_overflow_evicts_slow_watcher_only():
+    store = LogicalStore()
+    store._watch_queue = 8
+    slow = store.watch("configmaps")
+    store._watch_queue = 0
+    healthy = store.watch("configmaps")
+    before = REGISTRY.counter("watch_evicted_total").value
+    for i in range(20):
+        store.create("configmaps", "t0", _cm(f"x{i}", "t0"))
+    store._flush_events()
+    assert slow.closed and slow.evicted
+    assert REGISTRY.counter("watch_evicted_total").value == before + 1
+    # the healthy watcher is untouched: every committed event delivered
+    assert len(healthy.drain()) == 20
+    assert not healthy.evicted
+    healthy.close()
+    store.close()
+
+
+def test_watch_evict_fault_drill():
+    """The ``watch.evict`` KCP_FAULTS point force-evicts as if the
+    bounded queue overflowed — the backpressure path has a drill."""
+    faults.install(faults.FaultInjector("watch.evict:drop@tick=3"))
+    store = LogicalStore()
+    w = store.watch("configmaps")
+    for i in range(5):
+        store.create("configmaps", "t0", _cm(f"d{i}", "t0"))
+    store._flush_events()
+    assert w.closed and w.evicted
+    assert len(w.drain()) == 2  # pushes 1..2 landed; tick 3 evicted
+    store.close()
+
+
+def test_eviction_recovery_zero_lost_updates():
+    """Eviction → typed 410 → informer relist: the consumer converges
+    on the store's final state with zero lost updates (the PR 6
+    relist-NOW path closing the loop on backpressure)."""
+    from kcp_tpu.server.server import Config
+    from kcp_tpu.server.threaded import ServerThread
+
+    srv = ServerThread(Config(durable=False, install_controllers=False,
+                              tls=False)).start()
+    client = RestClient(srv.address, cluster="t0")
+
+    async def run() -> None:
+        loop = asyncio.get_running_loop()
+        client.create("configmaps", _cm("seed", "t0"))
+        inf = Informer(client, "configmaps")
+        await inf.start()
+        try:
+            # force-evict the server-side watch: stream must end in a
+            # terminal typed 410 and the informer must recover by relist
+            faults.install(faults.FaultInjector("watch.evict:drop@tick=1"))
+            await loop.run_in_executor(
+                None, client.create, "configmaps", _cm("during", "t0"))
+            await asyncio.sleep(0.3)
+            faults.clear()
+            await loop.run_in_executor(
+                None, client.create, "configmaps", _cm("after", "t0"))
+            deadline = loop.time() + 15
+            while loop.time() < deadline:
+                if {"seed", "during", "after"} <= \
+                        {k[2] for k in inf.cache}:
+                    break
+                await asyncio.sleep(0.05)
+            assert {"seed", "during", "after"} <= \
+                {k[2] for k in inf.cache}
+        finally:
+            await inf.stop()
+
+    try:
+        asyncio.run(run())
+    finally:
+        faults.clear()
+        client.close()
+        srv.stop()
+
+
+def test_slow_socket_evicted_with_terminal_410(monkeypatch):
+    """Handler-level eviction: a client that stops reading while the
+    fan-out keeps writing crosses KCP_WATCH_BUFFER_MAX and gets a
+    terminal typed 410 buffered on its way out."""
+    import socket as _socket
+    from urllib.parse import urlsplit
+
+    from kcp_tpu.server.server import Config
+    from kcp_tpu.server.threaded import ServerThread
+
+    monkeypatch.setenv("KCP_WATCH_BUFFER_MAX", "2048")
+    monkeypatch.setenv("KCP_WATCH_FLUSH_MS", "1")
+    srv = ServerThread(Config(durable=False, install_controllers=False,
+                              tls=False)).start()
+    client = RestClient(srv.address, cluster="t0")
+    sk = _socket.socket()
+    try:
+        client.create("configmaps", _cm("seed", "t0"))
+        before = REGISTRY.counter("watch_evicted_total").value
+        parts = urlsplit(srv.address)
+        # a tiny receive window: backpressure must reach the server's
+        # transport buffer instead of vanishing into kernel buffers
+        sk.setsockopt(_socket.SOL_SOCKET, _socket.SO_RCVBUF, 2048)
+        sk.settimeout(5)
+        sk.connect((parts.hostname, parts.port))
+        sk.sendall(b"GET /clusters/t0/api/v1/configmaps?watch=true "
+                   b"HTTP/1.1\r\nHost: t\r\n\r\n")
+        pad = "x" * 8192
+        deadline = time.time() + 20
+        i = 0
+        while (REGISTRY.counter("watch_evicted_total").value == before
+               and time.time() < deadline):
+            client.update("configmaps", {
+                "apiVersion": "v1", "kind": "ConfigMap",
+                "metadata": {"name": "seed", "namespace": "default",
+                             "clusterName": "t0"},
+                "data": {"v": str(i), "pad": pad}})
+            i += 1
+        assert REGISTRY.counter("watch_evicted_total").value == before + 1
+        data = b""
+        try:
+            while True:
+                chunk = sk.recv(65536)
+                if not chunk:
+                    break
+                data += chunk
+        except (TimeoutError, OSError):
+            pass
+        assert b'"code": 410' in data and b'"reason": "Expired"' in data
+    finally:
+        sk.close()
+        client.close()
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# flush coalescing: byte-identical to the per-batch wire
+# ---------------------------------------------------------------------------
+
+
+def test_coalesced_stream_byte_identical_to_per_batch(monkeypatch):
+    """The same seeded mutation run served with KCP_WATCH_COALESCE on
+    and off yields the exact same reassembled line stream (chunk
+    framing may differ; the payload may not), while the coalesced run
+    uses fewer flushes."""
+
+    async def one_mode(coalesce: bool) -> tuple[list[bytes], float]:
+        monkeypatch.setenv("KCP_WATCH_COALESCE", "1" if coalesce else "0")
+        monkeypatch.setenv("KCP_WATCH_FLUSH_MS", "5")
+        store = LogicalStore(clock=lambda: 0.0)
+        for i in range(8):
+            store.create("configmaps", "t0", {
+                "apiVersion": "v1", "kind": "ConfigMap",
+                "metadata": {"name": f"c{i}", "namespace": "default",
+                             "uid": f"u{i}"},
+                "data": {"v": "0"}})
+        handler = RestHandler(store, default_scheme(), admission=None)
+        handler.ready = True
+        srv = HttpServer(handler)
+        await srv.start()
+        flush0 = REGISTRY.counter("watch_flush_total").value
+        reader, writer = await asyncio.open_connection(srv.host, srv.port)
+        lines: list[bytes] = []
+        try:
+            writer.write(b"GET /clusters/t0/api/v1/configmaps?watch=true "
+                         b"HTTP/1.1\r\nHost: t\r\n\r\n")
+            await writer.drain()
+            await reader.readuntil(b"\r\n\r\n")
+
+            async def pump() -> None:
+                buf = b""
+                while True:
+                    size_line = await reader.readline()
+                    size = int(size_line.strip() or b"0", 16)
+                    if size == 0:
+                        return
+                    buf += await reader.readexactly(size)
+                    await reader.readexactly(2)
+                    *done, buf = buf.split(b"\n")
+                    lines.extend(d for d in done if d)
+
+            task = asyncio.ensure_future(pump())
+            for i in range(40):
+                store.update("configmaps", "t0", {
+                    "apiVersion": "v1", "kind": "ConfigMap",
+                    "metadata": {"name": f"c{i % 8}",
+                                 "namespace": "default"},
+                    "data": {"v": f"m{i}"}})
+                await asyncio.sleep(0.001)
+            deadline = asyncio.get_running_loop().time() + 5
+            while (len(lines) < 40
+                   and asyncio.get_running_loop().time() < deadline):
+                await asyncio.sleep(0.02)
+            task.cancel()
+        finally:
+            writer.close()
+            await srv.stop()
+            handler.close()
+            store.close()
+        return lines, REGISTRY.counter("watch_flush_total").value - flush0
+
+    async def run() -> None:
+        per_batch, f_pb = await one_mode(False)
+        coalesced, f_co = await one_mode(True)
+        assert per_batch == coalesced
+        assert len(per_batch) == 40
+        assert f_co < f_pb
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# bookmarks: quiet-period resume without a relist (satellite regression)
+# ---------------------------------------------------------------------------
+
+
+def test_bookmark_quiet_period_resumes_without_410(monkeypatch):
+    """A stream that sat quiet while OTHER tenants churned past its
+    original rv must still resume without a 410: periodic server
+    BOOKMARKs advance the informer's resume point (without waking any
+    handler), so the drop lands inside the window and fast resume skips
+    the relist entirely."""
+    from kcp_tpu.server.server import Config
+    from kcp_tpu.server.threaded import ServerThread
+
+    monkeypatch.setenv("KCP_WATCH_BOOKMARK_S", "0.15")
+    srv = ServerThread(Config(durable=False, install_controllers=False,
+                              tls=False)).start()
+    client = RestClient(srv.address, cluster="t0")
+    other = RestClient(srv.address, cluster="t9")
+    lists = 0
+    orig_list = client.list
+
+    def counting_list(*a, **kw):
+        nonlocal lists
+        lists += 1
+        return orig_list(*a, **kw)
+
+    client.list = counting_list
+
+    async def run() -> None:
+        loop = asyncio.get_running_loop()
+        store = srv.server.store
+        client.create("configmaps", _cm("seed", "t0"))
+        inf = Informer(client, "configmaps")
+        await inf.start()
+        try:
+            assert lists == 1
+            # the RestWatch connects lazily from the pump task — it must
+            # be ESTABLISHED (at its in-window resume point) before the
+            # window shrinks, or the shrink races the initial connect
+            deadline = loop.time() + 10
+            while (not getattr(inf._watch, "responded", False)
+                   and loop.time() < deadline):
+                await asyncio.sleep(0.01)
+            assert getattr(inf._watch, "responded", False)
+            # shrink the window, then churn a DIFFERENT tenant far past
+            # it — without bookmarks the informer's resume point (the
+            # initial list rv) would now be outside the window
+            srv.call(lambda: setattr(
+                store, "_history", deque(store._history, maxlen=8)))
+            for i in range(40):
+                other.create("configmaps", _cm(f"noise{i}", "t9"))
+            # quiet period long enough for >=1 bookmark at 0.15s cadence
+            deadline = loop.time() + 8
+            while loop.time() < deadline:
+                if (inf._watch is not None
+                        and getattr(inf._watch, "last_rv", 0)
+                        >= store.resource_version):
+                    break
+                await asyncio.sleep(0.05)
+            assert getattr(inf._watch, "last_rv", 0) >= \
+                store.resource_version, "bookmark never advanced last_rv"
+            before = REGISTRY.counter("informer_fast_resumes_total").value
+            # sever the stream; the informer must fast-resume (no 410,
+            # no relist) because the bookmark kept it inside the window
+            inf._watch.close()
+            deadline = loop.time() + 10
+            while loop.time() < deadline:
+                if REGISTRY.counter(
+                        "informer_fast_resumes_total").value > before:
+                    break
+                await asyncio.sleep(0.05)
+            assert REGISTRY.counter(
+                "informer_fast_resumes_total").value == before + 1
+            # the resumed stream is live: a new event reaches the cache
+            await loop.run_in_executor(
+                None, client.create, "configmaps", _cm("fresh", "t0"))
+            deadline = loop.time() + 10
+            while loop.time() < deadline:
+                if any(k[2] == "fresh" for k in inf.cache):
+                    break
+                await asyncio.sleep(0.05)
+            assert any(k[2] == "fresh" for k in inf.cache)
+            assert lists == 1, "fast resume must not relist"
+        finally:
+            await inf.stop()
+
+    try:
+        asyncio.run(run())
+    finally:
+        client.close()
+        other.close()
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# router: fresh watch streams spread across a shard's replicas
+# ---------------------------------------------------------------------------
+
+
+def test_router_spreads_fresh_watches_across_replicas(tmp_path):
+    from kcp_tpu.server.server import Config
+    from kcp_tpu.server.threaded import ServerThread
+
+    primary = ServerThread(Config(
+        durable=True, install_controllers=False, tls=False,
+        root_dir=str(tmp_path / "p"))).start()
+    replica = ServerThread(Config(
+        durable=False, install_controllers=False, tls=False,
+        role="replica", primary=primary.address)).start()
+    router = ServerThread(Config(
+        role="router", durable=False, tls=False,
+        shards=f"s0={primary.address}|{replica.address}")).start()
+    try:
+        pc = RestClient(primary.address, cluster="t0")
+        pc.create("configmaps", _cm("pre", "t0"))
+        pc.close()
+        # wait for the replica to apply the seed write
+        rc = RestClient(replica.address, cluster="t0")
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            st = rc._request("GET", "/replication/status")
+            if st["applied_rv"] >= 1 and st["connected"]:
+                break
+            time.sleep(0.05)
+        rc.close()
+
+        before = REGISTRY.counter("router_watch_spread_total").value
+        c = RestClient(router.address, cluster="t0")
+        wc = RestClient(router.address, cluster="t0")
+
+        async def scenario() -> None:
+            loop = asyncio.get_running_loop()
+            watches = [c.watch("configmaps", "default") for _ in range(4)]
+            try:
+                for w in watches:
+                    w._ensure_started()
+                deadline = loop.time() + 10
+                while (not all(w.responded for w in watches)
+                       and loop.time() < deadline):
+                    await asyncio.sleep(0.05)
+                assert all(w.responded for w in watches)
+                await loop.run_in_executor(
+                    None, wc.create, "configmaps", _cm("during", "t0"))
+                for w in watches:
+                    ev = await asyncio.wait_for(w.__anext__(), timeout=15)
+                    assert ev.name == "during"
+            finally:
+                for w in watches:
+                    w.close()
+
+        asyncio.run(scenario())
+        wc.close()
+        c.close()
+        # round-robin over [replica, primary]: 4 fresh streams = 2 spread
+        assert REGISTRY.counter(
+            "router_watch_spread_total").value == before + 2
+    finally:
+        router.stop()
+        replica.stop()
+        primary.stop()
+
+
+def test_resume_through_router_stays_on_primary(tmp_path):
+    """A watch resume (?resourceVersion=) is pinned to the primary: the
+    spread counter must not move and the resumed stream replays from
+    the primary's window."""
+    from kcp_tpu.server.server import Config
+    from kcp_tpu.server.threaded import ServerThread
+
+    primary = ServerThread(Config(
+        durable=True, install_controllers=False, tls=False,
+        root_dir=str(tmp_path / "p"))).start()
+    replica = ServerThread(Config(
+        durable=False, install_controllers=False, tls=False,
+        role="replica", primary=primary.address)).start()
+    router = ServerThread(Config(
+        role="router", durable=False, tls=False,
+        shards=f"s0={primary.address}|{replica.address}")).start()
+    try:
+        pc = RestClient(router.address, cluster="t0")
+        for i in range(5):
+            pc.create("configmaps", _cm(f"r{i}", "t0"))
+        before = REGISTRY.counter("router_watch_spread_total").value
+        w = pc.watch("configmaps", "default", since_rv=2)
+
+        async def collect() -> list:
+            out = []
+            async for ev in w:
+                out.append(ev.name)
+                if len(out) == 3:
+                    break
+            return out
+
+        names = asyncio.run(collect())
+        assert names == ["r2", "r3", "r4"]
+        assert REGISTRY.counter(
+            "router_watch_spread_total").value == before
+        w.close()
+        pc.close()
+    finally:
+        router.stop()
+        replica.stop()
+        primary.stop()
