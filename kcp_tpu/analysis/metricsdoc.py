@@ -10,6 +10,16 @@ names exactly; f-string names as globs, e.g. ``fused_{name}_seconds`` ->
 tokens of docs/operations.md — ``<name>``/``*`` in doc tokens match glob
 segments, so ``workqueue_depth_<name>`` documents the
 ``workqueue_depth_{queue}`` family.
+
+Trace spans get the same discipline (PR 12): every literal name at an
+``obs.span(...)`` / ``obs.record_span(...)`` call site, and every phase
+literal at an ``obs.phase(...)`` site (which records ``conv.<phase>``),
+must appear as a backticked token inside the trace-span table region of
+docs/operations.md (delimited by ``<!-- trace-spans:begin -->`` /
+``<!-- trace-spans:end -->``), and every dotted token in that region
+must be emitted by code — both directions, so the phase table an
+operator reads while chasing a convergence regression can never drift
+from what the tracer actually records.
 """
 
 from __future__ import annotations
@@ -99,6 +109,64 @@ def collect_doc_tokens(docs_path: str) -> dict[str, int]:
     return tokens
 
 
+SPAN_BEGIN = "<!-- trace-spans:begin -->"
+SPAN_END = "<!-- trace-spans:end -->"
+
+#: obs call sites whose first literal argument names a span (phase
+#: literals record as ``conv.<phase>``)
+SPAN_METHODS = frozenset({"span", "record_span"})
+
+
+def collect_code_spans(files: list[SourceFile]) -> dict[str, tuple[str, int]]:
+    """Span name -> first call site, from literal ``obs.span``/
+    ``obs.record_span``/``obs.phase`` arguments across the file set."""
+    spans: dict[str, tuple[str, int]] = {}
+    for f in files:
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            fn = node.func
+            if not isinstance(fn, ast.Attribute):
+                continue
+            if fn.attr not in SPAN_METHODS and fn.attr != "phase":
+                continue
+            recv = attr_chain(fn.value)
+            if not recv.endswith("obs"):
+                continue
+            arg = node.args[0]
+            if not (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)):
+                continue
+            name = arg.value if fn.attr != "phase" else "conv." + arg.value
+            spans.setdefault(name, (f.path, node.lineno))
+    return spans
+
+
+def collect_doc_spans(docs_path: str) -> dict[str, int]:
+    """Backticked dotted tokens inside the trace-span table region."""
+    tokens: dict[str, int] = {}
+    try:
+        with open(docs_path, encoding="utf-8") as fh:
+            lines = fh.readlines()
+    except OSError:
+        return tokens
+    inside = False
+    for lineno, line in enumerate(lines, start=1):
+        if SPAN_BEGIN in line:
+            inside = True
+            continue
+        if SPAN_END in line:
+            inside = False
+            continue
+        if not inside:
+            continue
+        for span_text in re.findall(r"`([^`]+)`", line):
+            tok = span_text.strip()
+            if re.fullmatch(r"[a-z][a-z0-9_]*(\.[a-z0-9_<>]+)+", tok):
+                tokens.setdefault(tok, lineno)
+    return tokens
+
+
 def _doc_token_concrete(tok: str) -> str:
     """A doc token with placeholders, concretized for glob matching:
     ``workqueue_depth_<name>`` -> ``workqueue_depth_x``."""
@@ -115,7 +183,7 @@ class MetricsDocChecker(RepoChecker):
         docs_path = os.path.join(repo_root, DOCS_REL)
         tokens = collect_doc_tokens(docs_path)
         if not tokens and not literals:
-            return findings
+            return self._check_spans(files, docs_path)
         concrete = {t: _doc_token_concrete(t) for t in tokens}
 
         # code -> docs: every registered metric is documented
@@ -158,6 +226,30 @@ class MetricsDocChecker(RepoChecker):
                 f"docs/operations.md documents metric {tok!r} but nothing "
                 f"in the codebase registers it — stale docs or a renamed "
                 f"metric"))
+
+        findings.extend(self._check_spans(files, docs_path))
+        return findings
+
+    def _check_spans(self, files: list[SourceFile],
+                     docs_path: str) -> list[Finding]:
+        """Trace spans <-> the docs trace-span table, both directions."""
+        findings: list[Finding] = []
+        code_spans = collect_code_spans(files)
+        doc_spans = collect_doc_spans(docs_path)
+        for name, (path, line) in sorted(code_spans.items()):
+            if name not in doc_spans:
+                findings.append(Finding(
+                    self.name, path, line,
+                    f"trace span {name!r} is recorded here but absent "
+                    f"from the trace-span table in {DOCS_REL} (between "
+                    f"the trace-spans markers) — document it"))
+        for tok, lineno in sorted(doc_spans.items()):
+            if tok not in code_spans:
+                findings.append(Finding(
+                    self.name, DOCS_REL, lineno,
+                    f"the trace-span table documents {tok!r} but no "
+                    f"obs.span/obs.phase/obs.record_span call site "
+                    f"records it — stale docs or a renamed span"))
         return findings
 
 

@@ -1,0 +1,27 @@
+"""kcp_tpu.obs — fleet-wide distributed tracing (see obs/trace.py)."""
+
+from .trace import (
+    PHASES,
+    TRACEPARENT,
+    TRACER,
+    TraceContext,
+    conv_begin,
+    ctx_from_wal,
+    current,
+    link_obj,
+    obj_link,
+    phase,
+    record_span,
+    reset_current,
+    set_current,
+    span,
+    use,
+    write_ctx,
+)
+
+__all__ = [
+    "PHASES", "TRACEPARENT", "TRACER", "TraceContext", "conv_begin",
+    "ctx_from_wal", "current", "link_obj", "obj_link", "phase",
+    "record_span", "reset_current", "set_current", "span", "use",
+    "write_ctx",
+]

@@ -28,8 +28,10 @@ import asyncio
 import http.client
 import json
 import logging
+import time
 from urllib.parse import urlsplit
 
+from .. import obs
 from ..faults import maybe_fail
 from ..server.rest import RestWatch, _status_error
 from ..utils import errors
@@ -342,9 +344,20 @@ class ReplicationApplier:
                     else:  # a WAL record
                         rv = int(m.get("rv", 0))
                         self.last_seen_rv = max(self.last_seen_rv, rv)
+                        tctx = obs.ctx_from_wal(m.get("tc"))
+                        t0 = time.time() if tctx is not None else 0.0
                         if self.store.apply_replicated(
                                 m, epoch=self._stream_epoch):
                             applied += 1
+                        if tctx is not None:
+                            # the primary's sampled write rides the
+                            # record: this follower's apply lands in ITS
+                            # buffer under the same trace id, assembled
+                            # by the router's /debug/trace scatter
+                            obs.record_span(
+                                "repl.apply", obs.TRACER.child(tctx),
+                                tctx.span_id, t0, time.time() - t0,
+                                {"rv": str(rv), "role": self.role})
                 if applied:
                     self._applied_total.inc(applied)
                 self._applied_gauge.set(self.store.resource_version)

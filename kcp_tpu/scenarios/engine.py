@@ -261,6 +261,10 @@ async def _drive(sspec: ScenarioSpec, seed: int, schedule, topology,
             finally:
                 if inj is not None:
                     faults_mod.clear()
+            traces = await loop.run_in_executor(
+                None, _fetch_slowest_traces, base)
+            if traces:
+                measurements.setdefault("_traces", {})[phase.name] = traces
             if phase.settle_s:
                 await asyncio.sleep(phase.settle_s)
         # coverage settle: give observers time to catch up with every
@@ -272,6 +276,32 @@ async def _drive(sspec: ScenarioSpec, seed: int, schedule, topology,
         for o in observers:
             await o.stop()
     return observers
+
+
+def _fetch_slowest_traces(base_url: str, n: int = 3) -> list[dict]:
+    """The 3 slowest assembled traces at a phase boundary, compacted for
+    the scorecard (kcp_tpu/obs): an SLO breach in SCENARIOS_rNN.json
+    ships with its own explanation. On a router topology the endpoint
+    scatter-gathers every shard's buffer; best-effort — a topology mid-
+    chaos may refuse, and the scorecard then simply has no trace."""
+    from .. import obs
+    from ..obs import assemble
+
+    if not obs.TRACER.enabled:
+        return []
+    client = RestClient(base_url)
+    try:
+        body = client._request("GET", f"/debug/trace?slowest={n}") or {}
+    except (errors.ApiError, ConnectionError, OSError):
+        return []
+    finally:
+        client.close()
+    out = []
+    for t in body.get("traces", [])[:n]:
+        summary = assemble.summarize_trace(t.get("spans", []), t.get("id"))
+        if summary:
+            out.append(summary)
+    return out
 
 
 def _acked_by_tenant(stats: WriterStats) -> dict[str, set]:
@@ -503,6 +533,11 @@ def run_scenario(spec: ScenarioSpec, seed: int = 42, scale: float = 1.0,
         slo_rows.append({"name": slo.name, "metric": slo.metric,
                          "op": slo.op, "target": slo.target,
                          "observed": observed, "passed": ok})
+    traces = measurements.get("_traces")
+    if traces:
+        # the 3 slowest assembled convergence traces per phase: the
+        # scorecard's own explanation for any latency SLO it reports
+        result["traces"] = traces
     result["measurements"] = {k: v for k, v in measurements.items()
                               if not k.startswith("_")}
     result["slos"] = slo_rows

@@ -30,6 +30,7 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+from .. import obs
 from ..server.rest import RestClient
 from ..utils import errors
 
@@ -178,21 +179,33 @@ def run_writer(base_url: str, tenant: str, ops: list[Op], stats: WriterStats,
             retried = False
             while True:
                 t0 = time.monotonic()
+                # driver-side trace root: the whole op (incl. the server
+                # round trip) is the convergence timeline's "write"
+                # phase — the scenario engine attaches the slowest
+                # assembled traces to the scorecard per phase
+                tctx = None
+                if obs.TRACER.enabled and obs.TRACER.head_sampled():
+                    tctx = obs.TRACER.mint(sampled=True)
+                tw0 = time.time()
                 try:
-                    if op.kind == "create":
-                        resp = c.create(RESOURCE, _obj(op.tenant, op.name,
-                                                       op.step))
-                    elif op.kind == "update":
-                        resp = c.update(RESOURCE, _obj(op.tenant, op.name,
-                                                       op.step))
-                    else:
-                        c.delete(RESOURCE, op.name, NAMESPACE)
-                        resp = None
+                    with obs.use(tctx):
+                        if op.kind == "create":
+                            resp = c.create(RESOURCE, _obj(
+                                op.tenant, op.name, op.step))
+                        elif op.kind == "update":
+                            resp = c.update(RESOURCE, _obj(
+                                op.tenant, op.name, op.step))
+                        else:
+                            c.delete(RESOURCE, op.name, NAMESPACE)
+                            resp = None
                     stats.latency(phase, klass, time.monotonic() - t0)
                     rv = 0
                     if resp is not None:
                         rv = int(resp.get("metadata", {})
                                  .get("resourceVersion", "0"))
+                    if tctx is not None and tctx.sampled:
+                        obs.phase("write", tctx, tw0, time.time(),
+                                  rv=str(rv), obj=op.name)
                     stats.ack(op.tenant, op.name, rv, op.kind)
                     break
                 except errors.AlreadyExistsError:

@@ -23,6 +23,7 @@ import json
 import os
 import time
 
+from .. import obs
 from ..admission.chain import NOOP_TICKET
 from ..apis.scheme import GVR, ResourceInfo, Scheme
 from ..store.selectors import parse_selector
@@ -203,6 +204,71 @@ class RestHandler:
     # ------------------------------------------------------------- routing
 
     async def __call__(self, req: Request) -> Response | StreamResponse:
+        """Serve one request under a trace context (kcp_tpu/obs/): the
+        incoming ``traceparent`` is honored, otherwise a root is minted
+        (head-sampled); the span records only when sampled — except
+        SLO-breaching requests (> KCP_TRACE_SLO_MS), which force-record
+        so a latency regression always comes with its own explanation.
+        Under ``KCP_TRACE=0`` this wrapper is one attribute read."""
+        tracer = obs.TRACER
+        if not tracer.enabled:
+            return await self._handle(req)
+        tp = req.headers.get(obs.TRACEPARENT)
+        if tp is None and not tracer.head_sampled():
+            # the overwhelmingly common case — untraced arrival, coin
+            # says no: one header probe, one coin draw, two clock reads;
+            # the SLO check still upgrades a slow request afterwards
+            t0 = time.time()
+            resp = await self._handle(req)
+            dur = time.time() - t0
+            if dur >= tracer.slo_s:
+                self._slo_span(None, req, resp, t0, dur)
+            return resp
+        ctx = tracer.from_headers(req.headers) if tp else \
+            tracer.mint(sampled=True)
+        if ctx is None or not ctx.sampled:
+            # propagated-but-unsampled (or malformed) header: same
+            # unsampled path, but an SLO breach keeps the caller's trace
+            t0 = time.time()
+            resp = await self._handle(req)
+            dur = time.time() - t0
+            if dur >= tracer.slo_s:
+                self._slo_span(ctx, req, resp, t0, dur)
+            return resp
+        sub = tracer.child(ctx)
+        token = obs.set_current(sub)
+        t0 = time.time()
+        status = 500
+        try:
+            resp = await self._handle(req)
+            status = getattr(resp, "status", 200)
+            return resp
+        finally:
+            obs.reset_current(token)
+            dur = time.time() - t0
+            attrs = {"method": req.method, "path": req.path,
+                     "status": status}
+            if dur >= tracer.slo_s:
+                attrs["slo_breach"] = True
+            obs.record_span("server.request", sub, ctx.span_id, t0,
+                            dur, attrs)
+
+    @staticmethod
+    def _slo_span(ctx, req: Request, resp, t0: float, dur: float) -> None:
+        """Force-record the serving span of an SLO-breaching request
+        that head sampling skipped — a latency regression always ships
+        with its own explanation."""
+        tracer = obs.TRACER
+        base = ctx or tracer.mint(sampled=False)
+        if base is None:
+            return
+        obs.record_span(
+            "server.request", tracer.child(base), base.span_id, t0, dur,
+            {"method": req.method, "path": req.path,
+             "status": getattr(resp, "status", 200), "slo_breach": True},
+            force=True)
+
+    async def _handle(self, req: Request) -> Response | StreamResponse:
         if self.draining.is_set():
             # graceful drain: in-flight requests were waited out BEFORE
             # the flag flipped; anything arriving now (a request that
@@ -279,10 +345,14 @@ class RestHandler:
                 seconds = 2.0
             return Response.of_json(await sample_profile(seconds))
         if head == "debug" and segs[1:] == ["trace"]:
-            # on-demand XLA/device trace (xprof): the device-side half of
-            # the profiling story. Same gate as /debug/profile.
+            # distributed-trace queries (?id= / ?slowest=N) serve this
+            # process's span ring buffer; without either param the legacy
+            # on-demand XLA/device trace (xprof) is preserved below.
+            # Same server-global gate either way.
             if not await self._server_scope_allowed(req):
                 return self._forbidden(req, "trace")
+            if req.param("id") or req.param("slowest"):
+                return self._trace_query(req)
             import tempfile
 
             from ..utils.trace import device_trace
@@ -411,6 +481,24 @@ class RestHandler:
         except errors.ApiError as e:
             return _error_response(e)
 
+    @staticmethod
+    def _trace_query(req: Request) -> Response:
+        """Serve this process's span ring buffer: ``?id=<trace>`` returns
+        one trace's spans, ``?slowest=N`` the N slowest buffered traces.
+        The router scatter-gathers this endpoint across shards to
+        assemble cross-process trees."""
+        tracer = obs.TRACER
+        tid = req.param("id")
+        if tid:
+            return Response.of_json({
+                "id": tid, "proc": tracer.proc, "spans": tracer.get(tid)})
+        try:
+            n = max(1, min(int(req.param("slowest") or "3"), 32))
+        except ValueError:
+            n = 3
+        return Response.of_json({
+            "proc": tracer.proc, "traces": tracer.slowest(n)})
+
     def _openapi_v2(self, cluster: str) -> dict:
         """Serve the cluster's swagger document: an attached
         ``store.openapi_doc`` wins (the fake physical cluster's discovery
@@ -515,8 +603,10 @@ class RestHandler:
             if adm is None:
                 ticket = NOOP_TICKET
             else:
-                got = adm.admit_nowait("create", res, target, namespace, obj)
-                ticket = got if hasattr(got, "ok") else await got
+                with obs.span("admission.admit", verb="create"):
+                    got = adm.admit_nowait("create", res, target, namespace,
+                                           obj)
+                    ticket = got if hasattr(got, "ok") else await got
             try:
                 created = await self._st(
                     self.store.create, res, target, obj, namespace)
@@ -538,8 +628,10 @@ class RestHandler:
             if adm is None:
                 ticket = NOOP_TICKET
             else:
-                got = adm.admit_nowait("update", res, target, namespace, obj)
-                ticket = got if hasattr(got, "ok") else await got
+                with obs.span("admission.admit", verb="update"):
+                    got = adm.admit_nowait("update", res, target, namespace,
+                                           obj)
+                    ticket = got if hasattr(got, "ok") else await got
             try:
                 if subresource == "status":
                     updated = await self._st(
@@ -560,8 +652,10 @@ class RestHandler:
             if adm is None:
                 ticket = NOOP_TICKET
             else:
-                got = adm.admit_nowait("delete", res, target, namespace, None)
-                ticket = got if hasattr(got, "ok") else await got
+                with obs.span("admission.admit", verb="delete"):
+                    got = adm.admit_nowait("delete", res, target, namespace,
+                                           None)
+                    ticket = got if hasattr(got, "ok") else await got
             try:
                 await self._st(self.store.delete, res, target, name, namespace)
             except BaseException:
@@ -790,7 +884,8 @@ class RestHandler:
         loss. No standby, no wait (async replication)."""
         hub = self.repl_hub
         if hub is not None and hub.has_sync_subscribers:
-            await hub.wait_committed(self.store.resource_version)
+            with obs.span("repl.ack"):
+                await hub.wait_committed(self.store.resource_version)
 
     def _check_replica_lag(self) -> None:
         """Reads on a replica past KCP_REPL_LAG_MAX refuse 503 — for

@@ -22,6 +22,7 @@ import threading
 import time
 from urllib.parse import quote, urlsplit
 
+from .. import obs
 from ..analysis.sanitize import make_lock
 from ..apis.scheme import GVR, ResourceInfo, Scheme, default_scheme
 from ..faults import maybe_fail, should_drop
@@ -414,12 +415,38 @@ class RestClient:
     def _request(self, method: str, path: str, body: dict | None = None) -> dict | None:
         """One JSON verb round trip (see :meth:`_roundtrip` for the retry
         and circuit-breaker discipline); raises the mapped ApiError on
-        HTTP error statuses."""
+        HTTP error statuses.
+
+        Tracing: with KCP_TRACE on, the request carries a ``traceparent``
+        header — the current context's child when one is installed (a
+        traced caller, e.g. a syncer apply), else a freshly minted
+        head-sampled root; sampled round trips record a
+        ``client.request`` span. KCP_TRACE=0 skips even the header, so
+        the wire is byte-identical to the pre-tracing client."""
         payload = json.dumps(body).encode() if body is not None else None
         headers = {"Content-Type": "application/json"} if payload else {}
         if self.token:
             headers["Authorization"] = f"Bearer {self.token}"
+        tracer = obs.TRACER
+        sub = t0 = None
+        if tracer.enabled:
+            ctx = obs.current()
+            if ctx is None and tracer.head_sampled():
+                ctx = tracer.mint(sampled=True)
+            if ctx is not None and ctx.sampled:
+                sub = tracer.child(ctx)
+                headers[obs.TRACEPARENT] = sub.header()
+                t0 = time.time()
+            elif ctx is not None:
+                # a traced-but-unsampled caller still propagates, so a
+                # downstream SLO force-record shares its trace id
+                headers[obs.TRACEPARENT] = ctx.header()
         status, resp, data = self._roundtrip(method, path, payload, headers)
+        if sub is not None:
+            obs.record_span(
+                "client.request", sub, ctx.span_id, t0, time.time() - t0,
+                {"method": method, "path": path.partition("?")[0][:160],
+                 "status": status})
         retry_after = None
         if status == 429:
             # a throttling answer is the peer ALIVE (the breaker saw

@@ -50,6 +50,7 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from urllib.parse import quote, urlencode, urlsplit
 
+from .. import obs
 from ..analysis.sanitize import make_lock
 from ..faults import maybe_fail
 from ..server.handler import CLUSTER_HEADER, DEFAULT_CLUSTER, _error_response, _status_body
@@ -67,6 +68,55 @@ log = logging.getLogger(__name__)
 
 _ITEMS_MARKER = b'"items": ['
 _RV_RE = re.compile(rb'"resourceVersion": "(\d+)"')
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{([^}]*)\})?\s+(.*)$")
+
+
+def _merge_expositions(parts: list[tuple[str, str]]) -> str:
+    """Merge per-process Prometheus expositions into one page: every
+    sample line gains a ``shard="<label>"`` label (appended after any
+    existing labels), HELP/TYPE are emitted once per metric (first
+    source wins), and metrics group together so the page stays valid
+    exposition format (one TYPE per family)."""
+    meta: dict[str, list[str]] = {}
+    samples: dict[str, list[str]] = {}
+    order: list[str] = []
+    for label, text in parts:
+        esc = label.replace("\\", "\\\\").replace('"', '\\"')
+        for line in text.splitlines():
+            if not line.strip():
+                continue
+            if line.startswith("# HELP ") or line.startswith("# TYPE "):
+                name = line.split(None, 3)[2]
+                if name not in meta:
+                    meta[name] = []
+                    order.append(name)
+                if not any(ln.split(None, 3)[1] == line.split(None, 3)[1]
+                           for ln in meta[name]):
+                    meta[name].append(line)
+                continue
+            if line.startswith("#"):
+                continue
+            m = _SAMPLE_RE.match(line)
+            if m is None:
+                continue
+            name, _braces, labels, value = m.groups()
+            family = name
+            for suffix in ("_bucket", "_sum", "_count"):
+                if name.endswith(suffix) and name[:-len(suffix)] in meta:
+                    family = name[:-len(suffix)]
+                    break
+            new_labels = (f'{labels},shard="{esc}"' if labels
+                          else f'shard="{esc}"')
+            if family not in samples and family not in meta:
+                order.append(family)
+            samples.setdefault(family, []).append(
+                f"{name}{{{new_labels}}} {value}")
+    out: list[str] = []
+    for name in order:
+        out.extend(meta.get(name, ()))
+        out.extend(samples.get(name, ()))
+    return "\n".join(out) + "\n"
 
 
 class _TapWatch(RestWatch):
@@ -384,6 +434,11 @@ class RouterHandler:
             v = req.headers.get(k)
             if v:
                 h[out] = v
+        # trace propagation: the shard's server span parents onto the
+        # router's relay span (the current context installed by __call__)
+        ctx = obs.current()
+        if ctx is not None:
+            h[obs.TRACEPARENT] = ctx.header()
         return h
 
     @staticmethod
@@ -425,6 +480,54 @@ class RouterHandler:
     # ------------------------------------------------------------ routing
 
     async def __call__(self, req: Request) -> Response | StreamResponse:
+        """Route one request under a trace context: the client's
+        ``traceparent`` is honored (else a head-sampled root is minted),
+        the relay span covers the whole routing decision + shard round
+        trip(s), and ``_fwd_headers`` hands every shard hop the relay
+        span as its parent. SLO-breaching relays force-record."""
+        tracer = obs.TRACER
+        if not tracer.enabled:
+            return await self._route(req)
+        ctx = tracer.from_headers(req.headers)
+        if ctx is None and tracer.head_sampled():
+            ctx = tracer.mint(sampled=True)
+        if ctx is None or not ctx.sampled:
+            # unsampled fast path (the shard makes no decision of its
+            # own: no traceparent is forwarded, and its own coin stays
+            # in its pocket for direct traffic); SLO upgrade after the
+            # fact, mirroring the shard handler
+            t0 = time.time()
+            resp = await self._route(req)
+            dur = time.time() - t0
+            if dur >= tracer.slo_s:
+                base = ctx or tracer.mint(sampled=False)
+                if base is not None:
+                    sub = tracer.child(base)
+                    obs.record_span(
+                        "router.relay", sub, base.span_id, t0, dur,
+                        {"method": req.method, "path": req.path,
+                         "status": getattr(resp, "status", 200),
+                         "slo_breach": True}, force=True)
+            return resp
+        sub = tracer.child(ctx)
+        token = obs.set_current(sub)
+        t0 = time.time()
+        status = 500
+        try:
+            resp = await self._route(req)
+            status = getattr(resp, "status", 200)
+            return resp
+        finally:
+            obs.reset_current(token)
+            dur = time.time() - t0
+            attrs = {"method": req.method, "path": req.path,
+                     "status": status}
+            if dur >= tracer.slo_s:
+                attrs["slo_breach"] = True
+            obs.record_span("router.relay", sub, ctx.span_id, t0, dur,
+                            attrs)
+
+    async def _route(self, req: Request) -> Response | StreamResponse:
         segs = [s for s in req.path.split("/") if s]
         cluster = req.headers.get(CLUSTER_HEADER, DEFAULT_CLUSTER)
         cluster_in_path = False
@@ -444,8 +547,13 @@ class RouterHandler:
             return Response(status=500, body=b"not ready",
                             content_type="text/plain")
         if head == "metrics":
+            if req.param("fleet") in ("1", "true"):
+                return await self._metrics_fleet(req)
             return Response(body=REGISTRY.expose().encode("utf-8"),
                             content_type="text/plain; version=0.0.4")
+        if head == "debug" and segs[1:] == ["trace"] and (
+                req.param("id") or req.param("slowest")):
+            return await self._trace_scatter(req)
         try:
             if head == "version":
                 return await self._version(req)
@@ -644,6 +752,118 @@ class RouterHandler:
         names = sorted({c for _s, _h, b in results
                         for c in json.loads(b).get("clusters", [])})
         return Response.of_json({"clusters": names})
+
+    # ----------------------------------------------- fleet observability
+
+    def _obs_sources(self) -> list[tuple[str, int, ConnectionPool | None]]:
+        """Every scrape/trace source behind this router: each shard's
+        primary (pool None = the current primary slot) and its replicas,
+        labeled ``s0`` / ``s0/replica0`` style."""
+        out: list[tuple[str, int, ConnectionPool | None]] = []
+        for i, shard in enumerate(self.ring.shards):
+            out.append((shard.name, i, None))
+            for j, pool in enumerate(self._rpools[i]):
+                out.append((f"{shard.name}/replica{j}", i, pool))
+        return out
+
+    async def _fan_fetch(self, target: str, headers: dict[str, str]
+                         ) -> list[tuple[str, bytes | None, str]]:
+        """GET ``target`` from every source in parallel; returns
+        ``(label, body-or-None, error)`` per source — failures are
+        reported, never silently dropped."""
+        sources = self._obs_sources()
+
+        async def one(label: str, idx: int, pool):
+            try:
+                status, _h, body = await self._call(
+                    idx, "GET", target, None, headers, pool=pool,
+                    who=label)
+            except (errors.ApiError, ConnectionError, OSError) as e:
+                return (label, None, f"{type(e).__name__}: {e}")
+            if status >= 400:
+                return (label, None, f"HTTP {status}")
+            return (label, body, "")
+
+        return list(await asyncio.gather(
+            *(one(label, idx, pool) for label, idx, pool in sources)))
+
+    async def _metrics_fleet(self, req: Request) -> Response:
+        """``GET /metrics?fleet=1``: scatter every shard's and replica's
+        ``/metrics``, re-emit as one exposition with a ``shard=<label>``
+        label on every sample (the router's own metrics ride as
+        ``shard="router"``). A partial scatter is annotated with a
+        comment per missing source and counted
+        (``router_fleet_scrape_failed_total``) — the gauntlet scrapes
+        one endpoint and still learns the truth."""
+        results = await self._fan_fetch("/metrics", self._fwd_headers(req))
+        parts: list[tuple[str, str]] = [("router", REGISTRY.expose())]
+        notes: list[str] = []
+        for label, body, err in results:
+            if body is None:
+                notes.append(f"# fleet: source {label} unreachable: {err}")
+                REGISTRY.counter(
+                    "router_fleet_scrape_failed_total",
+                    "fleet metrics federation scrapes that could not "
+                    "reach a shard or replica").inc()
+            else:
+                parts.append((label, body.decode("utf-8", "replace")))
+        merged = _merge_expositions(parts)
+        text = ("\n".join(notes) + "\n" if notes else "") + merged
+        return Response(body=text.encode("utf-8"),
+                        content_type="text/plain; version=0.0.4")
+
+    async def _trace_scatter(self, req: Request) -> Response:
+        """Assemble cross-process traces: scatter ``/debug/trace`` to
+        every shard and replica, merge their span buffers with the
+        router's own. ``?id=`` unions one trace's spans;
+        ``?slowest=N`` re-ranks the union of everyone's slowest."""
+        tracer = obs.TRACER
+        tid = req.param("id")
+        query = (f"/debug/trace?id={quote(tid)}" if tid
+                 else f"/debug/trace?slowest={quote(req.param('slowest'))}")
+        results = await self._fan_fetch(query, self._fwd_headers(req))
+        partial = [f"{label}: {err}" for label, body, err in results
+                   if body is None]
+        docs = []
+        for _label, body, _err in results:
+            if body is None:
+                continue
+            try:
+                docs.append(json.loads(body))
+            except ValueError:
+                continue
+        if tid:
+            spans = {(s["trace"], s["span"]): s for s in tracer.get(tid)}
+            for d in docs:
+                for s in d.get("spans", []):
+                    spans.setdefault((s["trace"], s["span"]), s)
+            out = sorted(spans.values(), key=lambda s: s["t0"])
+            return Response.of_json({
+                "id": tid, "proc": tracer.proc, "spans": out,
+                "partial": partial})
+        try:
+            n = max(1, min(int(req.param("slowest") or "3"), 32))
+        except ValueError:
+            n = 3
+        by_trace: dict[str, dict] = {}
+        for t in tracer.slowest(n):
+            by_trace[t["id"]] = {(s["trace"], s["span"]): s
+                                 for s in t["spans"]}
+        for d in docs:
+            for t in d.get("traces", []):
+                ent = by_trace.setdefault(t["id"], {})
+                for s in t.get("spans", []):
+                    ent.setdefault((s["trace"], s["span"]), s)
+        ranked = []
+        for t_id, spans in by_trace.items():
+            vals = list(spans.values())
+            t0 = min(s["t0"] for s in vals)
+            t1 = max(s["t0"] + s["dur"] for s in vals)
+            ranked.append({"id": t_id, "dur": round(t1 - t0, 6),
+                           "spans": sorted(vals, key=lambda s: s["t0"])})
+        ranked.sort(key=lambda t: -t["dur"])
+        return Response.of_json({
+            "proc": tracer.proc, "traces": ranked[:n], "partial": partial})
 
     # -------------------------------------------------------------- watch
 

@@ -100,6 +100,7 @@ from typing import Any, Callable, Iterator, Mapping
 
 import numpy as np
 
+from .. import obs
 from ..analysis import sanitize as _sanitize
 from ..faults import maybe_fail, should_drop
 from ..utils.errors import (
@@ -702,9 +703,28 @@ class LogicalStore:
                     "newer replication epoch").inc()
             raise UnavailableError(f"store is read-only: {self.read_only}")
 
+    def _commit_trace(self, tctx, t0: float, key: Key, rv: int,
+                      rec: dict, obj: dict | None) -> None:
+        """Stamp a sampled write's trace onto its WAL record (``tc``
+        rides the replication feed) and link the stored snapshot to the
+        committing context (in-process informers resolve causality by
+        object identity); records the ``store.commit`` span. One stamp
+        covers every watcher/subscriber — the events already carry the
+        context (see :meth:`_emit`)."""
+        sub = obs.TRACER.child(tctx)
+        rec["tc"] = [sub.trace_id, sub.span_id]
+        obs.record_span(
+            "store.commit", sub, tctx.span_id, t0, time.time() - t0,
+            {"resource": key[0], "cluster": key[1], "name": key[3],
+             "rv": str(rv), "op": rec["op"]})
+        if obj is not None:
+            obs.link_obj(obj, sub)
+
     def create(self, resource: str, cluster: str, obj: dict, namespace: str = "") -> dict:
         self._race_guard.check()
         self._check_writable()
+        tctx = obs.write_ctx()
+        t0 = time.time() if tctx is not None else 0.0
         _inject("store.put")
         obj = copy.deepcopy(obj)
         meta = obj.setdefault("metadata", {})
@@ -735,8 +755,11 @@ class LogicalStore:
         rv = self._next_rv()
         meta["resourceVersion"] = str(rv)
         obj = self._put_obj(key, obj)
-        self._emit(ADDED, key, obj, rv)
-        self._log_wal({"op": "put", "key": list(key), "obj": obj, "rv": rv})
+        self._emit(ADDED, key, obj, rv, tc=tctx)
+        rec = {"op": "put", "key": list(key), "obj": obj, "rv": rv}
+        if tctx is not None:
+            self._commit_trace(tctx, t0, key, rv, rec, obj)
+        self._log_wal(rec)
         return copy.deepcopy(obj)
 
     def get(self, resource: str, cluster: str, name: str, namespace: str = "") -> dict:
@@ -770,6 +793,8 @@ class LogicalStore:
     ) -> dict:
         self._race_guard.check()
         self._check_writable()
+        tctx = obs.write_ctx()
+        t0 = time.time() if tctx is not None else 0.0
         _inject("store.put")
         obj = copy.deepcopy(obj)
         meta = self._meta(obj)
@@ -824,11 +849,17 @@ class LogicalStore:
         # finalizer-driven deletion completion
         if new_meta.get("deletionTimestamp") and not new_meta.get("finalizers"):
             self._del_obj(key)
-            self._emit(DELETED, key, new_obj, rv, old=existing)
-            self._log_wal({"op": "del", "key": list(key), "rv": rv})
+            self._emit(DELETED, key, new_obj, rv, old=existing, tc=tctx)
+            rec = {"op": "del", "key": list(key), "rv": rv}
+            if tctx is not None:
+                self._commit_trace(tctx, t0, key, rv, rec, None)
+            self._log_wal(rec)
         else:
-            self._emit(MODIFIED, key, new_obj, rv, old=existing)
-            self._log_wal({"op": "put", "key": list(key), "obj": new_obj, "rv": rv})
+            self._emit(MODIFIED, key, new_obj, rv, old=existing, tc=tctx)
+            rec = {"op": "put", "key": list(key), "obj": new_obj, "rv": rv}
+            if tctx is not None:
+                self._commit_trace(tctx, t0, key, rv, rec, new_obj)
+            self._log_wal(rec)
         return copy.deepcopy(new_obj)
 
     def update_status(self, resource: str, cluster: str, obj: dict, namespace: str = "") -> dict:
@@ -837,6 +868,8 @@ class LogicalStore:
     def delete(self, resource: str, cluster: str, name: str, namespace: str = "") -> None:
         self._race_guard.check()
         self._check_writable()
+        tctx = obs.write_ctx()
+        t0 = time.time() if tctx is not None else 0.0
         _inject("store.delete")
         key = self._key(resource, cluster, namespace, name)
         existing = self._objects.get(key)
@@ -850,13 +883,19 @@ class LogicalStore:
                 rv = self._next_rv()
                 obj["metadata"]["resourceVersion"] = str(rv)
                 obj = self._put_obj(key, obj)
-                self._emit(MODIFIED, key, obj, rv, old=existing)
-                self._log_wal({"op": "put", "key": list(key), "obj": obj, "rv": rv})
+                self._emit(MODIFIED, key, obj, rv, old=existing, tc=tctx)
+                rec = {"op": "put", "key": list(key), "obj": obj, "rv": rv}
+                if tctx is not None:
+                    self._commit_trace(tctx, t0, key, rv, rec, obj)
+                self._log_wal(rec)
             return
         self._del_obj(key)
         rv = self._next_rv()
-        self._emit(DELETED, key, existing, rv, old=existing)
-        self._log_wal({"op": "del", "key": list(key), "rv": rv})
+        self._emit(DELETED, key, existing, rv, old=existing, tc=tctx)
+        rec = {"op": "del", "key": list(key), "rv": rv}
+        if tctx is not None:
+            self._commit_trace(tctx, t0, key, rv, rec, None)
+        self._log_wal(rec)
 
     # --------------------------------------------------------------- list
 
@@ -1250,12 +1289,19 @@ class LogicalStore:
                 del rs[:self._hist_start]
                 self._hist_start = 0
 
-    def _emit(self, etype: str, key: Key, obj: dict, rv: int, old: dict | None = None) -> None:
+    def _emit(self, etype: str, key: Key, obj: dict, rv: int, old: dict | None = None,
+              tc=None) -> None:
         if not self._indexed:
             ev = Event(
                 etype, key[0], key[1], key[2], key[3], copy.deepcopy(obj), rv,
                 copy.deepcopy(old) if old is not None else None,
             )
+            if tc is not None:
+                # the committing write's trace context rides the shared
+                # Event (one stamp for every watcher — the encode-once
+                # discipline applied to causality); out-of-band like
+                # _enc_line, never on the wire
+                object.__setattr__(ev, "_tc", tc)
             self._history.append(ev)
             self._note_history(ev)
             # snapshot: an injected watch drop closes (and unsubscribes)
@@ -1269,6 +1315,8 @@ class LogicalStore:
         # replaces the whole dict), so the event shares them — the
         # per-event double deepcopy of the legacy path is gone
         ev = Event(etype, key[0], key[1], key[2], key[3], obj, rv, old)
+        if tc is not None:
+            object.__setattr__(ev, "_tc", tc)
         self._history.append(ev)
         self._note_history(ev)
         self._pending.append(ev)
@@ -1300,12 +1348,24 @@ class LogicalStore:
             self._fanout(batch)
         finally:
             self._flushing = False
+            dt = time.perf_counter() - t0
             REGISTRY.histogram("watch_fanout_batch_size",
                                "events coalesced per watch fan-out pass",
                                buckets=SIZE_BUCKETS).observe(len(batch))
             REGISTRY.histogram("store_emit_seconds",
-                               "time delivering one fan-out batch").observe(
-                time.perf_counter() - t0)
+                               "time delivering one fan-out batch").observe(dt)
+            if obs.TRACER.enabled:
+                # attribute the flush to the first sampled event's trace
+                # (the batch shares one delivery pass; one span suffices)
+                for ev in batch:
+                    tc = ev.__dict__.get("_tc")
+                    if tc is not None:
+                        now = time.time()
+                        obs.record_span(
+                            "store.fanout", obs.TRACER.child(tc),
+                            tc.span_id, now - dt, dt,
+                            {"events": len(batch)})
+                        break
 
     def _fanout(self, batch: list[Event]) -> None:
         if not self._watches:
@@ -1631,6 +1691,10 @@ class LogicalStore:
         if rv <= self._rv:
             return False
         key: Key = tuple(rec["key"])  # type: ignore[assignment]
+        # the primary's sampled-write trace context rides the shipped
+        # record: replica-side events carry the same causality, and the
+        # re-logged record keeps it for chained followers
+        tctx = obs.ctx_from_wal(rec.get("tc"))
         if op == "put":
             old = self._objects.get(key)
             # ownership transfer: the record dict was parsed off the
@@ -1638,16 +1702,24 @@ class LogicalStore:
             obj = self._put_obj(key, rec["obj"])
             self._rv = rv
             self._emit(MODIFIED if old is not None else ADDED,
-                       key, obj, rv, old=old)
-            self._log_wal({"op": "put", "key": list(key), "obj": obj,
-                           "rv": rv})
+                       key, obj, rv, old=old, tc=tctx)
+            out_rec = {"op": "put", "key": list(key), "obj": obj,
+                       "rv": rv}
+            if tctx is not None:
+                out_rec["tc"] = rec["tc"]
+                obs.link_obj(obj, tctx)
+            self._log_wal(out_rec)
         elif op == "del":
             existing = self._objects.get(key)
             self._del_obj(key)
             self._rv = rv
             if existing is not None:
-                self._emit(DELETED, key, existing, rv, old=existing)
-            self._log_wal({"op": "del", "key": list(key), "rv": rv})
+                self._emit(DELETED, key, existing, rv, old=existing,
+                           tc=tctx)
+            out_rec = {"op": "del", "key": list(key), "rv": rv}
+            if tctx is not None:
+                out_rec["tc"] = rec["tc"]
+            self._log_wal(out_rec)
         else:
             raise InvalidError(f"unknown replication record op {op!r}")
         return True

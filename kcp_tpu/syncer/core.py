@@ -1608,6 +1608,10 @@ class FusedCore:
         #    the old bucket — drop them, the replacement section was
         #    re-enqueued with the same keys.
         t0 = time.perf_counter()
+        # wall-clock tick anchor for convergence attribution: the engine
+        # stamps which dispatch carried a traced row by pairing this with
+        # its fused_apply callback time (kcp_tpu/obs — phase "tick")
+        self.last_tick_start = time.time()
         # per key, remember WHICH side(s) this batch's events touched —
         # an informer event changes exactly one mirror side (the
         # reference's two controllers each watch one apiserver,

@@ -46,6 +46,7 @@ from typing import Sequence
 
 import numpy as np
 
+from .. import obs
 from ..apis.scheme import GVR
 from ..client import Client, Informer
 from ..ops.diff import (
@@ -211,6 +212,16 @@ class BatchSyncEngine:
         self.dirty_since: dict[tuple[str, str], float] = {}
         self.convergence_samples: "deque[float]" = deque(maxlen=10_000)
         self.stats = {"ticks": 0, "decisions_applied": 0, "rows": 0, "full_uploads": 0}
+        # convergence trace attribution (kcp_tpu/obs): key -> the traced
+        # spec write's context + the phase-boundary timestamps gathered
+        # as the row moves stage → tick → patch → downstream → upstatus.
+        # Entries exist only for sampled writes (identity-linked
+        # snapshots, or engine-minted fragments under always-on
+        # sampling), bounded FIFO — the steady-state cost when tracing
+        # is on but nothing is sampled is one dict-emptiness check.
+        self._conv: dict[tuple[str, str], dict] = {}
+        self._conv_max = 1024
+        self._tick_bounds: tuple[float, float] | None = None
 
     def tick_count(self) -> int:
         """Reconcile ticks that covered this engine's rows (fused mode
@@ -230,6 +241,16 @@ class BatchSyncEngine:
         key = self._obj_key(new or old)
         self.dirty_since.setdefault(key, time.monotonic())
         self._apply_failures.pop(key, None)  # new data resets the budget
+        if new is not None and obs.TRACER.enabled and key not in self._conv:
+            ctx = obs.conv_begin(new)
+            if ctx is not None:
+                while len(self._conv) >= self._conv_max:
+                    self._conv.pop(next(iter(self._conv)))
+                meta = new.get("metadata") or {}
+                self._conv[key] = {
+                    "ctx": ctx, "state": "staged", "t0": time.time(),
+                    "rv": str(meta.get("resourceVersion", "")),
+                    "name": meta.get("name", "")}
         if self.fused:
             if self._section is not None:
                 self.core.enqueue(self._section, False, key)
@@ -239,6 +260,14 @@ class BatchSyncEngine:
     def _on_down_event(self, etype: str, old: dict | None, new: dict | None) -> None:
         key = self._obj_key(new or old)
         self._apply_failures.pop(key, None)
+        if self._conv:
+            # downstream churn (our own create echo, then the status
+            # write) re-stages the row: remember the LAST arrival as the
+            # downstream→upsync boundary (phases recorded at upsync)
+            ent = self._conv.get(key)
+            if ent is not None and ent["state"] in ("patched", "downstaged"):
+                ent["t_down"] = time.time()
+                ent["state"] = "downstaged"
         if self.fused:
             if self._section is not None:
                 self.core.enqueue(self._section, True, key)
@@ -296,6 +325,19 @@ class BatchSyncEngine:
     def fused_apply(self, patches: list[tuple[tuple[str, str], int, bool]]) -> None:
         """Patch rows from a collected tick: feed the applier pool
         (dedup per key; the pool re-verifies against live caches)."""
+        if self._conv and patches:
+            # stamp which fused dispatch carried each traced row: the
+            # core's wall-clock tick anchor + this collect time bound
+            # the "tick" phase, and the bucket tick counter names it
+            t1 = time.time()
+            t0 = getattr(self.core, "last_tick_start", None) or t1
+            tick_n = (self._section.bucket.stats.get("ticks")
+                      if self._section is not None else None)
+            for key, _code, _upsync in patches:
+                ent = self._conv.get(key)
+                if ent is not None and "tb" not in ent:
+                    ent["tb"] = (t0, t1)
+                    ent["tick"] = tick_n
         for key, code, upsync in patches:
             if key in self._apply_pending:
                 continue
@@ -469,6 +511,7 @@ class BatchSyncEngine:
         from ..utils.trace import REGISTRY
 
         self.stats["ticks"] += 1
+        t_tick0 = time.time()
         REGISTRY.counter("kcp_sync_ticks_total",
                          "reconcile ticks across all sync sessions").inc()
         REGISTRY.counter("kcp_sync_events_total",
@@ -494,6 +537,9 @@ class BatchSyncEngine:
         if n == 0:
             return []
         decision, upsync = self._host_decisions()
+        # wall-clock tick bounds for convergence attribution (the host
+        # backend's analog of the fused core's last_tick_start)
+        self._tick_bounds = (t_tick0, time.time())
 
         # 4. apply non-NOOP rows with host verification
         failed_keys: dict[tuple[str, str], Exception] = {}
@@ -596,11 +642,29 @@ class BatchSyncEngine:
 
     # ------------------------------------------------------------- apply
 
+    def _conv_phases_pre(self, ent: dict) -> None:
+        """Record the stage + tick phases of a traced row the first time
+        an actionable decision reaches the applier: staged→tick-start is
+        queue wait, tick-start→tick-end is the dispatch that carried the
+        row (fused: the core's wall anchor; host: the batch bounds)."""
+        tb = ent.get("tb") or self._tick_bounds or (ent["t0"], ent["t0"])
+        t0 = max(ent["t0"], min(tb[0], tb[1]))
+        ctx = ent["ctx"]
+        obs.phase("stage", ctx, ent["t0"], t0, rv=ent["rv"],
+                  obj=ent["name"])
+        obs.phase("tick", ctx, t0, max(t0, tb[1]), rv=ent["rv"],
+                  tick=ent.get("tick"))
+        ent["state"] = "ticked"
+        ent["t_tick1"] = max(t0, tb[1])
+
     def _apply_decision(self, key: tuple[str, str], decision: int, upsync: bool) -> bool:
         ns, name = key
         up_obj = self.up_informer.get(self._up_cluster(), name, ns)
         down_obj = self.down_informer.get(self._down_cluster(), name, ns)
         applied = False
+        ent = self._conv.get(key) if self._conv else None
+        if ent is not None and ent["state"] == "staged" and decision:
+            self._conv_phases_pre(ent)
 
         if decision == DECISION_CREATE and up_obj is not None:
             self._ensure_namespace(ns)
@@ -633,13 +697,38 @@ class BatchSyncEngine:
             except errors.NotFoundError:
                 pass
 
+        if ent is not None and ent["state"] == "ticked":
+            # the downstream write (or delete) for this traced row just
+            # applied: tick-end → now is the patch phase
+            now = time.time()
+            obs.phase("patch", ent["ctx"], ent["t_tick1"], now,
+                      rv=ent["rv"], applied=applied)
+            ent["state"] = "patched"
+            ent["t_patch"] = now
+
         if upsync and up_obj is not None and down_obj is not None:
             new_status = down_obj.get("status")
             if new_status != up_obj.get("status"):
                 fresh = self.upstream.get(self.gvr, name, ns)
                 fresh["status"] = copy.deepcopy(new_status)
-                self.upstream.update_status(self.gvr, fresh, namespace=ns)
+                with obs.use(ent["ctx"] if ent is not None else None):
+                    # upstream status write runs under the row's trace
+                    # context: an in-process upstream records its
+                    # store.commit as a child; a REST upstream carries
+                    # the traceparent to the owning shard
+                    self.upstream.update_status(self.gvr, fresh,
+                                                namespace=ns)
                 applied = True
+                if ent is not None and ent["state"] in ("patched",
+                                                        "downstaged"):
+                    now = time.time()
+                    t_patch = ent.get("t_patch", ent["t0"])
+                    t_down = ent.get("t_down", t_patch)
+                    obs.phase("downstream", ent["ctx"], t_patch, t_down,
+                              rv=ent["rv"])
+                    obs.phase("upstatus", ent["ctx"], t_down, now,
+                              rv=ent["rv"], obj=ent["name"])
+                    self._conv.pop(key, None)
 
         if applied or decision or upsync:
             started = self.dirty_since.pop(key, None)

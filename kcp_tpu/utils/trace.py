@@ -50,9 +50,17 @@ class Counter:
     name: str
     help: str = ""
     value: float = 0.0
+    # REST clients, the store-I/O pool, and the router's scatter executor
+    # all inc() off the serving loop: `self.value += amount` is a
+    # read-add-store that can drop increments under thread interleaving.
+    # A plain leaf lock (never held while acquiring anything else) keeps
+    # the hot path one uncontended acquire.
+    _lock: threading.Lock = field(default_factory=threading.Lock,
+                                  repr=False, compare=False)
 
     def inc(self, amount: float = 1.0) -> None:
-        self.value += amount
+        with self._lock:
+            self.value += amount
 
 
 @dataclass
@@ -62,7 +70,7 @@ class Gauge:
     value: float = 0.0
 
     def set(self, value: float) -> None:
-        self.value = value
+        self.value = value  # single store: atomic under the GIL
 
 
 @dataclass
@@ -73,6 +81,8 @@ class Histogram:
     counts: list = field(default_factory=list)
     total: float = 0.0
     n: int = 0
+    _lock: threading.Lock = field(default_factory=threading.Lock,
+                                  repr=False, compare=False)
 
     def __post_init__(self):
         if not self.counts:
@@ -80,10 +90,15 @@ class Histogram:
 
     def observe(self, value: float) -> None:
         # bisect_left: an observation equal to a bucket edge belongs in
-        # that bucket (Prometheus's inclusive `le` semantics)
-        self.counts[bisect_left(self.buckets, value)] += 1
-        self.total += value
-        self.n += 1
+        # that bucket (Prometheus's inclusive `le` semantics). The lock
+        # makes the three mutations one transaction — observe() runs on
+        # executor threads too, and a torn counts/total/n triple yields
+        # impossible exposition (count < bucket cum sums).
+        i = bisect_left(self.buckets, value)
+        with self._lock:
+            self.counts[i] += 1
+            self.total += value
+            self.n += 1
 
     @property
     def mean(self) -> float:
@@ -125,6 +140,14 @@ class Registry:
                 m = self._metrics[name] = factory()
             return m
 
+    @staticmethod
+    def _escape_help(text: str) -> str:
+        """Prometheus text-format HELP escaping: backslash and newline
+        are the two characters the exposition grammar reserves — an
+        unescaped newline in help text splits the line and corrupts
+        every scrape of the whole page."""
+        return text.replace("\\", "\\\\").replace("\n", "\\n")
+
     def expose(self) -> str:
         """Prometheus text format (the /metrics body)."""
         out: list[str] = []
@@ -132,7 +155,7 @@ class Registry:
             for name in sorted(self._metrics):
                 m = self._metrics[name]
                 if m.help:
-                    out.append(f"# HELP {name} {m.help}")
+                    out.append(f"# HELP {name} {self._escape_help(m.help)}")
                 if isinstance(m, Counter):
                     out.append(f"# TYPE {name} counter")
                     out.append(f"{name} {m.value}")
@@ -141,13 +164,15 @@ class Registry:
                     out.append(f"{name} {m.value}")
                 else:
                     out.append(f"# TYPE {name} histogram")
+                    with m._lock:
+                        counts, total, n = list(m.counts), m.total, m.n
                     cum = 0
-                    for edge, c in zip(m.buckets, m.counts):
+                    for edge, c in zip(m.buckets, counts):
                         cum += c
                         out.append(f'{name}_bucket{{le="{edge}"}} {cum}')
-                    out.append(f'{name}_bucket{{le="+Inf"}} {m.n}')
-                    out.append(f"{name}_sum {m.total}")
-                    out.append(f"{name}_count {m.n}")
+                    out.append(f'{name}_bucket{{le="+Inf"}} {n}')
+                    out.append(f"{name}_sum {total}")
+                    out.append(f"{name}_count {n}")
         return "\n".join(out) + "\n"
 
     def snapshot(self) -> dict:

@@ -244,6 +244,56 @@ print("watchers smoke ok: %d streams | p99 %sms | %s kb/watcher (soak %s)"
          sc["rss_per_watcher_kb"], sc["rss_soak_growth"], r["value"]))
 '
 
+echo "== trace: distributed-tracing smoke (off-path overhead floor, wire neutrality, assembled convergence trace)"
+# reduced-scale --trace lane: paired-block A/B of the serving and
+# fan-out hot paths across KCP_TRACE=0 / default 1-in-64 / always-on
+# (CI floor 5%; the committed BENCH_r07_trace.json gate is 3%),
+# byte-identical wires across all three modes, and a router + 2-shard +
+# standby convergence trace whose per-phase durations sum-reconcile
+# (±5%) with the measured spec→status wall time.
+tr_line=$(KCP_BENCH_TRACE_OBJECTS=1500 KCP_BENCH_TRACE_REQS=320 \
+    KCP_BENCH_TRACE_WATCHES=24 KCP_BENCH_TRACE_MUTS=240 \
+    KCP_BENCH_TRACE_CONV=2 python bench.py --trace | tail -1)
+printf '%s\n' "$tr_line" | python -c '
+import json, sys
+r = json.loads(sys.stdin.readline())
+tb = r["trace_bench"]
+assert tb["bytes_equal"], "wire bytes diverged under tracing"
+assert r["value"] < 5.0, "p50 overhead %s%% >= 5%% CI floor at default sampling" % r["value"]
+conv = tb["convergence"]
+assert conv["all_sum_ok"], conv["sum_reconciles"]
+need = {"write", "stage", "tick", "patch", "downstream", "upstatus"}
+assert need <= set(conv["phases_seen"]), conv["phases_seen"]
+names = set(conv["traces"][0]["names"])
+for s in ("server.request", "router.relay", "store.commit", "repl.ack", "repl.apply"):
+    assert s in names, (s, sorted(names))
+print("trace smoke ok: overhead %.2f%% | bytes equal | %d convergence traces sum-reconcile | %d span kinds"
+      % (r["value"], conv["runs"], len(names)))
+'
+
+echo "== trace: crud-churn scenario under always-on tracing (scorecard carries assembled traces)"
+# the scenario engine attaches the slowest assembled traces per phase
+# to the scorecard: assert at least one fully-assembled write trace
+# (driver conv.write + server span + store commit + fan-out) rode along
+KCP_TRACE=1 KCP_TRACE_SAMPLE=1 JAX_PLATFORMS=cpu python scripts/scenarios.py run \
+    --scenarios crud-churn --seed 7 --scale 0.25 --out SCENARIOS_trace_smoke.json
+python -c '
+import json
+r = json.load(open("SCENARIOS_trace_smoke.json"))
+s = r["scenarios"][0]
+assert s["passed"], s["slos"]
+traces = s.get("traces") or {}
+attached = [t for ph in traces.values() for t in ph]
+assert attached, "no traces attached to the scorecard"
+names = set()
+for t in attached:
+    names.update(t.get("names", []))
+for need in ("conv.write", "server.request", "store.commit", "store.fanout"):
+    assert need in names, (need, sorted(names))
+print("scenario trace smoke ok: %d attached traces across %d phases; %d distinct span names"
+      % (len(attached), len(traces), len(names)))
+'
+
 echo "== scenarios: seeded end-to-end chaos smoke (churn + reconnect storm + kill-the-primary drill)"
 # reduced-scale subset of the scenario harness (scripts/scenarios.py):
 # real topologies over real HTTP, hard SLO floors (zero lost acked

@@ -354,6 +354,44 @@ def f():
     assert any("'stale_metric_total' but nothing" in m for m in msgs)
 
 
+def test_trace_span_table_both_directions(tmp_path):
+    files, root = _metrics_fixture(tmp_path, """\
+from kcp_tpu import obs
+
+def f(ctx, t0, t1):
+    with obs.span("server.request"):
+        pass
+    obs.phase("stage", ctx, t0, t1)
+    obs.record_span("ghostless.span", ctx, None, t0, t1 - t0)
+""", "intro prose\n"
+         "<!-- trace-spans:begin -->\n"
+         "| `server.request` | docs |\n"
+         "| `conv.stage` | docs |\n"
+         "| `conv.undocumented_emitter` | stale row |\n"
+         "<!-- trace-spans:end -->\n"
+         "outside the region `other.token` is ignored\n")
+    msgs = [f.message for f in MetricsDocChecker().check_repo(files, root)]
+    # code -> docs: the record_span literal is missing from the table
+    assert any("'ghostless.span' is recorded here" in m for m in msgs)
+    # docs -> code: the stale table row has no emitter
+    assert any("'conv.undocumented_emitter' but no" in m for m in msgs)
+    # documented spans and out-of-region tokens are clean
+    assert not any("server.request" in m or "conv.stage" in m
+                   or "other.token" in m for m in msgs)
+
+
+def test_trace_span_table_in_sync_passes(tmp_path):
+    files, root = _metrics_fixture(tmp_path, """\
+from kcp_tpu import obs
+
+def f(ctx, t0, t1):
+    obs.phase("tick", ctx, t0, t1)
+""", "<!-- trace-spans:begin -->\n"
+         "| `conv.tick` | the reconcile dispatch |\n"
+         "<!-- trace-spans:end -->\n")
+    assert MetricsDocChecker().check_repo(files, root) == []
+
+
 def test_metrics_doc_span_sites_count(tmp_path):
     files, root = _metrics_fixture(tmp_path, """\
 from .trace import span
