@@ -81,6 +81,23 @@ def build_parser():
                             "s0=http://h0:6443,s1=http://h1:6443; a shard "
                             "entry may append |-separated read replicas, "
                             "e.g. s0=http://h0:6443|http://h0r:6444")
+    start.add_argument("--shard-name", default="",
+                       help="shard role: this server's stable name in the "
+                            "ring (env KCP_SHARD_NAME). With --ring-names "
+                            "set, direct smart-client requests (the "
+                            "X-Kcp-Ring-Epoch stamp) are verified against "
+                            "HRW ownership — a stale-ring client gets a "
+                            "typed 410 instead of the wrong shard's answer")
+    start.add_argument("--ring-names", default="",
+                       help="shard role: comma-separated names of every "
+                            "shard in the ring (env KCP_RING_NAMES); names "
+                            "alone determine HRW ownership, so no "
+                            "addresses are needed to verify direct "
+                            "requests")
+    start.add_argument("--ring-epoch", type=int, default=0,
+                       help="shard role: the ring epoch this shard was "
+                            "(re)started under, stamped on ring-mismatch "
+                            "410s (env KCP_RING_EPOCH, default 1)")
     start.add_argument("--primary", default="",
                        help="replica/standby roles: the primary server's "
                             "base URL (the /replication/wal feed source "
@@ -189,6 +206,9 @@ def config_from_args(args) -> Config:
         store_ca_file=args.store_ca_file,
         role=args.role,
         shards=args.shards,
+        shard_name=args.shard_name,
+        ring_names=args.ring_names,
+        ring_epoch=args.ring_epoch,
         primary=args.primary,
         repl_hysteresis_s=args.repl_hysteresis,
         repl_lag_max=args.repl_lag_max,

@@ -206,7 +206,7 @@ class ReplicationHub:
                 snapshot = []
                 tail = [line for rv, line in self._records
                         if since_rv < rv <= rv_now]
-            await stream.send_raw_many([header])
+            await stream.send_spans([header])
             if need_snapshot:
                 batch: list[bytes] = []
                 for key, obj in snapshot:
@@ -214,14 +214,17 @@ class ReplicationHub:
                         {"type": "SNAP", "key": list(key), "obj": obj},
                         separators=(",", ":")).encode() + b"\n")
                     if len(batch) >= 256:
-                        await stream.send_raw_many(batch)
+                        await stream.send_spans(batch)
                         batch = []
                 batch.append(json.dumps(
                     {"type": "BARRIER", "rv": rv_now}).encode() + b"\n")
-                await stream.send_raw_many(batch)
+                await stream.send_spans(batch)
                 self._shipped.inc(len(snapshot))
             elif tail:
-                await stream.send_raw_many(tail)
+                # the catchup tail is encode-once bytes (each record was
+                # serialized exactly once at commit): the raw-spans send
+                # hands them to the transport with no whole-batch join
+                await stream.send_spans(tail)
                 self._shipped.inc(len(tail))
             while True:
                 line = await sub.q.get()
@@ -239,7 +242,7 @@ class ReplicationHub:
                 if delay:
                     await asyncio.sleep(delay)
                 if batch:
-                    await stream.send_raw_many(batch)
+                    await stream.send_spans(batch)
                 if draining:
                     await stream.send_json({"type": "ERROR", "object": {
                         "kind": "Status", "apiVersion": "v1",

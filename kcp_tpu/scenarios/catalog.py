@@ -172,7 +172,39 @@ CRD_CHURN = ScenarioSpec(
     ),
 )
 
+RING_CHANGE = ScenarioSpec(
+    name="ring-change-under-load",
+    description="A live-workload shard drains and restarts on a NEW "
+                "address mid-phase and the router republishes /ring: "
+                "smart clients (even-index tenants go DIRECT to the HRW "
+                "owner) must absorb the move via one-shot router "
+                "fallbacks + a ring re-fetch, routed tenants via plain "
+                "retries — zero lost acked writes, zero stuck clients, "
+                "and a bounded p99 through the fallback window.",
+    topology="fleet",
+    topology_args={"shards": 3},
+    tenants=6,
+    watchers_per_tenant=1,
+    options={"pace_s": 0.02, "smart_half": True,
+             "coverage_timeout_s": 30.0},
+    phases=(Phase("warm", ops_per_tenant=20),
+            Phase("move", ops_per_tenant=80, action="move_shard",
+                  settle_s=1.5),
+            Phase("after", ops_per_tenant=20, settle_s=1.0)),
+    slos=(
+        SLO("no-lost-acked-writes", "lost_acked_writes", "==", 0),
+        SLO("no-stuck-clients", "gave_up", "==", 0),
+        SLO("no-lost-watch-events", "lost_watch_events", "==", 0),
+        SLO("fallback-window-p99", "phase_move_p99_ms", "<=", 15000.0),
+        SLO("smart-went-direct", "smart_client_direct", ">=", 1),
+        SLO("move-absorbed-by-fallback", "smart_client_fallback", ">=", 1),
+        SLO("ring-refetched", "smart_client_ring_refreshes", ">=", 1),
+        SLO("error-budget-5xx", "http_5xx", "<=", 400),
+    ),
+)
+
 SCENARIOS: dict[str, ScenarioSpec] = {
     s.name: s for s in (CRUD_CHURN, NOISY_NEIGHBOR, RECONNECT_STORM,
-                        ROLLING_RESTART, KILL_PRIMARY, CRD_CHURN)
+                        ROLLING_RESTART, KILL_PRIMARY, CRD_CHURN,
+                        RING_CHANGE)
 }

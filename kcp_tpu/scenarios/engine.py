@@ -50,7 +50,9 @@ log = logging.getLogger(__name__)
 
 #: process-global counters whose per-run deltas scenarios assert on
 TRACKED_COUNTERS = ("repl_promotions_total", "repl_rehome_total",
-                    "router_rehome_total")
+                    "router_rehome_total", "smart_client_direct_total",
+                    "smart_client_fallback_total",
+                    "smart_client_ring_refreshes_total")
 
 
 def pctile(vals: list[float], q: float) -> float:
@@ -77,6 +79,11 @@ async def _run_action(action: str, topology, observers, loop) -> None:
             await asyncio.sleep(0.3)
     elif action == "kill_primary":
         await loop.run_in_executor(None, topology.kill_primary)
+    elif action == "move_shard":
+        # the ring change: drain a live-workload shard, restart it on a
+        # NEW address, republish /ring — smart clients must absorb the
+        # move with one-shot fallbacks, routed clients with retries
+        await loop.run_in_executor(None, topology.move_shard)
     elif action == "drop_watchers":
         # the reconnect storm: EVERY stream severed in the same instant,
         # every observer resumes from its last_rv at once
@@ -234,12 +241,16 @@ async def _drive(sspec: ScenarioSpec, seed: int, schedule, topology,
                             None, run_crd_tenant, base, tenant_name(ti),
                             ops, phase_idx, stats, shared))
                 else:
+                    # smart_half: even-index tenants write DIRECT over
+                    # the ring (SmartRestClient), odd ones stay routed —
+                    # the same seeded schedule through both paths
+                    smart_half = bool(sspec.options.get("smart_half"))
                     for ti, ops in enumerate(schedule[phase.name]):
                         if ops:
                             writer_futs.append(loop.run_in_executor(
                                 None, run_writer, base, tenant_name(ti),
                                 ops, stats, phase.name, "quiet", 30.0,
-                                pace))
+                                pace, smart_half and ti % 2 == 0))
                 flood_fut = None
                 if phase.action == "flood":
                     flood_fut = loop.run_in_executor(
@@ -409,6 +420,15 @@ def _collect(sspec: ScenarioSpec, stats: WriterStats, observers,
     m["ambiguous_acks"] = stats.ambiguous
     m["gave_up"] = stats.gave_up
     m["duration_s"] = round(duration_s, 3)
+    # per-phase writer p99: what a client-visible op cost during each
+    # phase — the ring-change scenario bounds the fallback window's
+    # (`phase_move_p99_ms`) so "the move was absorbed" is a latency
+    # claim, not just a zero-loss claim
+    for ph, klasses in lat.items():
+        quiet = klasses.get("quiet", [])
+        if quiet:
+            m[f"phase_{ph}_p99_ms"] = round(
+                pctile(quiet, 0.99) * 1000, 3)
     # noisy-neighbor ratio: quiet p99 during the storm phase vs baseline
     base_lat = lat.get("baseline", {}).get("quiet", [])
     storm_lat = lat.get("storm", {}).get("quiet", [])

@@ -56,7 +56,9 @@ class Phase:
     ops_per_tenant: int = 0
     faults: str = ""        # KCP_FAULTS spec installed for this phase
     action: str = ""        # engine action: rolling_restart_drain |
-    # rolling_restart_kill | kill_primary | drop_watchers | flood
+    # rolling_restart_kill | kill_primary | drop_watchers | flood |
+    # move_shard (drain a shard, restart on a NEW address, republish
+    # /ring — the ring-change-under-load lever)
     settle_s: float = 0.3   # quiesce wait after the phase's work completes
 
 

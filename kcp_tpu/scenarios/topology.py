@@ -92,10 +92,15 @@ def shard_fleet(n: int, tls: bool = False, durable: bool = False,
         raise ValueError("durable shard_fleet needs a root_dir")
     shards: list[ServerThread] = []
     router = None
+    names = ",".join(f"s{i}" for i in range(n))
     try:
         for i in range(n):
+            # every shard knows the ring's NAMES and its own, so direct
+            # smart-client requests (X-Kcp-Ring-Epoch stamped) are
+            # ownership-verified; routed traffic is untouched
             kw: dict = dict(durable=durable, install_controllers=False,
-                            tls=tls)
+                            tls=tls, shard_name=f"s{i}", ring_names=names,
+                            ring_epoch=1)
             if durable:
                 kw["root_dir"] = os.path.join(root_dir, f"shard{i}")
             shards.append(ServerThread(Config(**kw)).start())
@@ -127,6 +132,37 @@ def restart_shard(shards: list, i: int, timeout: float = 30.0):
             last = e
             time.sleep(0.2)
     raise last
+
+
+def move_shard(shards: list, i: int, router_url: str, drain: bool = True,
+               timeout: float = 30.0):
+    """The elastic-topology primitive: take shard ``i`` down (drain by
+    default), bring it back on a NEW ephemeral address, and republish
+    the ring (``POST /ring``) so the router re-points its pools and
+    bumps the ring epoch. Smart clients going direct to the old address
+    fall back through the router once, re-fetch ``GET /ring``, and
+    follow the move; routed clients never notice beyond the restart
+    window. The shard's WAL (durable fleets) carries its data and RV
+    sequence across the move."""
+    from ..server.rest import RestClient
+
+    old = shards[i]
+    if drain:
+        old.drain()
+    old.stop()
+    cfg = dataclasses.replace(
+        old.server.config, listen_port=0,
+        ring_epoch=(old.server.config.ring_epoch or 1) + 1)
+    shards[i] = ServerThread(cfg).start(timeout=timeout)
+    spec = ",".join(
+        f"{t.server.config.shard_name or f's{j}'}={t.address}"
+        for j, t in enumerate(shards))
+    c = RestClient(router_url)
+    try:
+        c._request("POST", "/ring", {"shards": spec})
+    finally:
+        c.close()
+    return shards[i]
 
 
 # ---------------------------------------------------------------------------
@@ -234,9 +270,12 @@ class RouterFleet:
 
     def start(self) -> "RouterFleet":
         with _env_patch(self.env):
+            names = ",".join(f"s{i}" for i in range(self.n))
             for i in range(self.n):
                 kw: dict = dict(durable=self.durable,
-                                install_controllers=False, tls=False)
+                                install_controllers=False, tls=False,
+                                shard_name=f"s{i}", ring_names=names,
+                                ring_epoch=1)
                 if self.durable:
                     kw["root_dir"] = os.path.join(self.root_dir,
                                                   f"shard{i}")
@@ -261,6 +300,19 @@ class RouterFleet:
         else:
             self.shards[i].kill()
         restart_shard(self.shards, i)
+
+    def move_shard(self, i: int | None = None) -> None:
+        """The ring-change-under-load lever: drain a shard, restart it
+        on a NEW address, republish ``/ring``. With no index given, the
+        shard owning tenant ``t0`` moves — guaranteed to sit on a live
+        workload's write path."""
+        from ..sharding import ShardRing
+
+        if i is None:
+            spec = ",".join(f"s{j}={t.address}"
+                            for j, t in enumerate(self.shards))
+            i = ShardRing.from_spec(spec).owner_index("t0")
+        move_shard(self.shards, i, self.router.address)
 
     def stop(self) -> None:
         if self.router is not None:

@@ -160,7 +160,8 @@ def _obj(tenant: str, name: str, step: int) -> dict:
 
 def run_writer(base_url: str, tenant: str, ops: list[Op], stats: WriterStats,
                phase: str, klass: str = "quiet",
-               op_deadline_s: float = 30.0, pace_s: float = 0.0) -> None:
+               op_deadline_s: float = 30.0, pace_s: float = 0.0,
+               smart: bool = False) -> None:
     """Execute one tenant's op list (a blocking worker thread).
 
     Retry discipline mirrors a production client: 503/transport errors
@@ -168,8 +169,19 @@ def run_writer(base_url: str, tenant: str, ops: list[Op], stats: WriterStats,
     client-visible 5xx into the error budget), 429 honors Retry-After,
     and an AlreadyExists/NotFound answer to a RETRIED create/delete is
     an ack whose response was lost — the write landed, counted
-    ambiguous, never double-applied."""
-    c = RestClient(base_url, cluster=tenant)
+    ambiguous, never double-applied.
+
+    ``smart=True`` writes through a shard-aware
+    :class:`~kcp_tpu.client.smart.SmartRestClient` (direct to the HRW
+    owner, one-shot router fallback on ring staleness) — the
+    ring-change scenario runs smart and routed tenants side by side on
+    the same schedule."""
+    if smart:
+        from ..client.smart import SmartRestClient
+
+        c: RestClient = SmartRestClient(base_url, cluster=tenant)
+    else:
+        c = RestClient(base_url, cluster=tenant)
     try:
         for op in ops:
             if pace_s:

@@ -133,7 +133,10 @@ class RestHandler:
         # be tens of MB at 100k objects); bypassed while a KCP_FAULTS
         # schedule is active so encode.cache drops always reach the
         # per-record cache underneath.
-        self._list_cache: dict[tuple, tuple[int, bytes]] = {}
+        # entries are (rv, body spans, total bytes): the spans splice
+        # straight into Response.spans so even a cache hit never pays a
+        # whole-body join while the scatter wire path is on
+        self._list_cache: dict[tuple, tuple[int, tuple[bytes, ...], int]] = {}
         self._list_cache_max = 8
         # HA replication (kcp_tpu/replication/): the Server wires these.
         # repl_hub — primary-side WAL shipper (feed + acks + fencing);
@@ -170,6 +173,15 @@ class RestHandler:
         # inside the watch window across stream drops
         self._bookmark_every = float(
             os.environ.get("KCP_WATCH_BOOKMARK_S", "5"))
+        # smart-client ring identity (Server wires these from
+        # --shard-name/--ring-names): when set, a direct request that
+        # stamps X-Kcp-Ring-Epoch is verified against HRW ownership — a
+        # client holding a stale ring gets a typed 410 (refresh /ring)
+        # instead of a silently-wrong shard's answer. Routed traffic
+        # (no stamp) is untouched.
+        self.shard_name = ""
+        self.ring_names: tuple[str, ...] = ()
+        self.ring_epoch = 0
 
     async def _st(self, fn, *args, **kwargs):
         """Run a store call; offloaded to the I/O pool for remote stores."""
@@ -285,6 +297,22 @@ class RestHandler:
         if len(segs) >= 2 and segs[0] == "clusters":
             cluster = segs[1]
             segs = segs[2:]
+        if (self.shard_name and self.ring_names and cluster != WILDCARD
+                and "x-kcp-ring-epoch" in req.headers):
+            # a smart client came DIRECT with its ring stamp: verify HRW
+            # ownership (names alone determine it — URLs never enter the
+            # hash). A stale ring answers a typed 410 carrying OUR epoch;
+            # the client re-fetches /ring and takes one router hop.
+            from ..sharding.ring import owner_name
+
+            owner = owner_name(self.ring_names, cluster)
+            if owner != self.shard_name:
+                resp = _error_response(errors.GoneError(
+                    f"ring mismatch: cluster {cluster!r} is owned by "
+                    f"shard {owner!r}, not {self.shard_name!r} — "
+                    f"re-fetch /ring and retry"))
+                resp.headers["X-Kcp-Ring-Epoch"] = str(self.ring_epoch)
+                return resp
         if not segs:
             return Response.of_json({"paths": ["/api", "/apis", "/healthz", "/version"]})
         head = segs[0]
@@ -706,8 +734,8 @@ class RestHandler:
             if ent is not None and ent[0] == self.store.resource_version:
                 REGISTRY.counter("encode_cache_hits_total").inc()
                 REGISTRY.counter(
-                    "encode_cache_bytes_shared_total").inc(len(ent[1]))
-                return Response(body=ent[1])
+                    "encode_cache_bytes_shared_total").inc(ent[2])
+                return Response(spans=list(ent[1]))
         t0 = time.perf_counter()
         if selector.empty and self._spans:
             spans, rv = await self._st(
@@ -717,11 +745,13 @@ class RestHandler:
                 self.store.list, res, cluster, namespace or None, selector)
             spans = self.store.encode_many(items)
         # byte-splice: the envelope is dumped once with an empty items
-        # array, then the item/span bytes are joined in place of the
+        # array, then the item/span bytes are spliced in place of the
         # final `]}` — byte-identical to dumping the full dict, without
-        # re-serializing 100k objects per request. ONE join builds the
-        # body: at 100k objects the body is tens of MB, so every extra
-        # concatenation is a full-copy tax
+        # re-serializing 100k objects per request. The parts list IS the
+        # response body (Response.spans): the wire layer writes the
+        # spans scatter-style, so at 100k objects the tens-of-MB body is
+        # never materialized as one joined copy at all
+        # (KCP_WIRE_SCATTER; =0 restores the single join for A/B)
         head = json.dumps({
             "kind": info.list_kind, "apiVersion": gv,
             "metadata": {"resourceVersion": str(rv)},
@@ -733,14 +763,14 @@ class RestHandler:
                 parts.append(b", ")
             parts.append(span)
         parts.append(b"]}")
-        body = b"".join(parts)
+        total = sum(len(p) for p in parts)
         self._enc_seconds.observe(time.perf_counter() - t0)
         if cacheable:
             if (len(self._list_cache) >= self._list_cache_max
                     and ck not in self._list_cache):
                 self._list_cache.pop(next(iter(self._list_cache)))
-            self._list_cache[ck] = (rv, body)
-        return Response(body=body)
+            self._list_cache[ck] = (rv, tuple(parts), total)
+        return Response(spans=parts)
 
     def _get_encoded(self, res: str, cluster: str, name: str,
                      namespace: str) -> bytes | None:

@@ -14,7 +14,7 @@ import asyncio
 import json
 import logging
 import os
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Awaitable, Callable
 from urllib.parse import parse_qs, unquote, urlsplit
 
@@ -32,6 +32,18 @@ _FLUSHES = REGISTRY.counter(
 _FLUSH_BATCH = REGISTRY.histogram(
     "watch_flush_batch_size",
     "event lines merged into one stream flush", buckets=SIZE_BUCKETS)
+#: the zero-copy wire meters: spans handed to the transport through the
+#: scatter path (no whole-body b"".join), and the bytes that skipped the
+#: full-body join copy because of it. `bench.py --smartclient` proves the
+#: scatter path byte-identical to the join path (sha256 over the wire).
+_SPANS_WRITTEN = REGISTRY.counter(
+    "wire_spans_written_total",
+    "encode-once byte spans written through the scatter wire path "
+    "(KCP_WIRE_SCATTER) without an intermediate whole-body join")
+_JOIN_AVOIDED = REGISTRY.counter(
+    "wire_join_avoided_total",
+    "response-body bytes written without the whole-body b''.join copy "
+    "the legacy wire path paid (scatter path only)")
 
 MAX_HEADER_BYTES = 64 * 1024
 # listener accept backlog: a 10k-watcher reconnect storm lands thousands
@@ -44,6 +56,59 @@ LISTEN_BACKLOG = int(os.environ.get("KCP_LISTEN_BACKLOG", "4096"))
 # a single payload byte is buffered. 3 MiB default ~= the apiserver's
 # etcd request ceiling; read at import, overridable per-process.
 MAX_BODY_BYTES = int(os.environ.get("KCP_MAX_BODY_BYTES", str(3 * 1024 * 1024)))
+# spans below this size coalesce into one bounded join before hitting
+# the transport (a send syscall per 200-byte watch line would cost more
+# than the copy it saves); spans at or above it go to the transport
+# as-is — the writev-spirit scatter path for big encode-once spans
+# (pre-joined bucket spans, large objects)
+SCATTER_MIN = int(os.environ.get("KCP_WIRE_SCATTER_MIN", str(16 * 1024)))
+
+
+def scatter_enabled() -> bool:
+    """KCP_WIRE_SCATTER (default on): scatter/writev-style body writes —
+    span lists are handed to the transport without the whole-body
+    ``b"".join`` (big spans go as-is; small ones coalesce into bounded
+    <= SCATTER_MIN join buffers). ``=0`` restores the single-join wire
+    path for A/B; both produce byte-identical streams. Read per response
+    (one dict probe) so tests and benches can flip it on a live server."""
+    return os.environ.get("KCP_WIRE_SCATTER", "1").lower() not in (
+        "0", "false", "off")
+
+
+def _write_parts(writer: asyncio.StreamWriter, parts) -> None:
+    """Write ``parts`` (framing + spans) to the transport without one
+    whole-body join: spans >= SCATTER_MIN are written as-is (the bytes
+    the encode cache holds are the bytes on the wire — no intermediate
+    copy), smaller ones coalesce into bounded join buffers so tiny
+    spans don't become per-span syscalls."""
+    small: list[bytes] = []
+    small_len = 0
+    spans = 0
+    avoided = 0
+    for p in parts:
+        if len(p) >= SCATTER_MIN:
+            if small:
+                writer.write(small[0] if len(small) == 1 else b"".join(small))
+                small = []
+                small_len = 0
+            writer.write(p)
+            spans += 1
+            avoided += len(p)
+        else:
+            small.append(p)
+            small_len += len(p)
+            if small_len >= SCATTER_MIN:
+                writer.write(small[0] if len(small) == 1
+                             else b"".join(small))
+                spans += 1
+                small = []
+                small_len = 0
+    if small:
+        writer.write(small[0] if len(small) == 1 else b"".join(small))
+        spans += 1
+    _SPANS_WRITTEN.inc(spans)
+    if avoided:
+        _JOIN_AVOIDED.inc(avoided)
 
 
 class RequestTooLarge(Exception):
@@ -78,12 +143,41 @@ class Request:
         return json.loads(self.body)
 
 
-@dataclass
 class Response:
-    status: int = 200
-    body: bytes = b""
-    content_type: str = "application/json"
-    headers: dict[str, str] = field(default_factory=dict)
+    """One-shot response. ``spans`` is the zero-copy body form: a list of
+    byte spans whose concatenation IS the body (the handler's encode-once
+    list assembly hands the cached spans straight through and the wire
+    path writes them scatter-style, never paying the whole-body join).
+    ``.body`` stays correct for direct consumers — it joins lazily on
+    first access and memoizes; the HTTP write path checks ``spans``
+    first and never triggers that join while scatter is on."""
+
+    def __init__(self, status: int = 200, body: bytes = b"",
+                 content_type: str = "application/json",
+                 headers: dict[str, str] | None = None,
+                 spans: list[bytes] | None = None):
+        self.status = status
+        self._body = body
+        self.content_type = content_type
+        self.headers: dict[str, str] = headers if headers is not None else {}
+        self.spans = spans
+
+    @property
+    def body(self) -> bytes:
+        if self.spans is not None and not self._body:
+            self._body = b"".join(self.spans)
+        return self._body
+
+    @body.setter
+    def body(self, value: bytes) -> None:
+        self._body = value
+        self.spans = None
+
+    def body_len(self) -> int:
+        """Content-Length without materializing a joined body."""
+        if self.spans is not None and not self._body:
+            return sum(len(s) for s in self.spans)
+        return len(self._body)
 
     @classmethod
     def of_json(cls, obj, status: int = 200) -> "Response":
@@ -147,22 +241,55 @@ class StreamResponse:
         self.write_raw_many(lines)
         await self._writer.drain()
 
+    async def send_spans(self, lines) -> None:
+        """The raw-spans twin of :meth:`send_json_many`: encode-once byte
+        spans framed as ONE chunk and written scatter-style (no
+        whole-chunk ``b"".join`` while ``KCP_WIRE_SCATTER`` is on) + one
+        drain. The replication hub's batch sends ride this — a catchup
+        tail of N pre-encoded WAL records costs zero re-encodes and zero
+        whole-batch join copies."""
+        await self.send_raw_many(lines)
+
     def write_raw_many(self, lines) -> None:
         """Frame pre-encoded lines as ONE chunk and buffer them on the
         transport WITHOUT draining — the :class:`FlushCoalescer`'s write
         half. Backpressure is handled by eviction (the coalescer checks
         the transport buffer against ``KCP_WATCH_BUFFER_MAX``), never by
-        awaiting a slow socket."""
+        awaiting a slow socket. With ``KCP_WIRE_SCATTER`` on, the lines
+        go to the transport as spans (bounded coalescing, no whole-chunk
+        join); ``=0`` keeps the legacy single-join write — byte-identical
+        either way (same bytes, same single chunk frame)."""
         assert self._writer is not None
         if not lines:
             return
         tr = self._writer.transport
         if tr is None or tr.is_closing():
             raise ConnectionResetError("stream transport closed")
-        data = b"".join(lines)
-        self._writer.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+        total = sum(len(ln) for ln in lines)
+        if not total:
+            return  # an all-empty batch must not emit a terminal 0-chunk
+        if scatter_enabled():
+            _write_parts(self._writer,
+                         [f"{total:x}\r\n".encode(), *lines, b"\r\n"])
+        else:
+            data = b"".join(lines)
+            self._writer.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
         _FLUSHES.inc()
         _FLUSH_BATCH.observe(len(lines))
+
+    async def relay_chunk(self, size_line: bytes, payload: bytes) -> None:
+        """Forward one upstream chunk frame verbatim (the router's
+        zero-parse relay): the upstream's own length-delimited framing
+        and payload bytes go to the transport untouched — no decode, no
+        line split, no re-frame, no join."""
+        assert self._writer is not None
+        tr = self._writer.transport
+        if tr is None or tr.is_closing():
+            raise ConnectionResetError("stream transport closed")
+        self._writer.write(size_line)
+        self._writer.write(payload)
+        _FLUSHES.inc()
+        await self._writer.drain()
 
     def write_buffer_size(self) -> int:
         """Bytes buffered on this stream's transport — the slow-client
@@ -399,13 +526,19 @@ class HttpServer:
                         head = (
                             f"HTTP/1.1 {resp.status} {_reason(resp.status)}\r\n"
                             f"Content-Type: {resp.content_type}\r\n"
-                            f"Content-Length: {len(resp.body)}\r\n"
+                            f"Content-Length: {resp.body_len()}\r\n"
                         )
                         for k, v in resp.headers.items():
                             head += f"{k}: {v}\r\n"
                         head += ("Connection: "
                                  f"{'keep-alive' if keep else 'close'}\r\n\r\n")
-                        writer.write(head.encode() + resp.body)
+                        if resp.spans is not None and scatter_enabled():
+                            # zero-copy body: the encode-once spans go to
+                            # the transport without the whole-body join
+                            _write_parts(writer,
+                                         [head.encode(), *resp.spans])
+                        else:
+                            writer.write(head.encode() + resp.body)
                         await writer.drain()
                 finally:
                     self._busy -= 1

@@ -77,7 +77,14 @@ def _status_error(code: int, reason: str, message: str,
 
 
 def _raise_for_status(code: int, body: bytes,
-                      retry_after: float | None = None) -> None:
+                      retry_after: float | None = None,
+                      headers: dict[str, str] | None = None) -> None:
+    """Map an HTTP error status to the typed ApiError. ``headers`` (the
+    response headers, lower-cased keys) ride the raised error as
+    ``err.http_headers`` — relayed errors keep ``Retry-After`` /
+    ``X-Kcp-Ring-Epoch`` visible to callers on the direct path too (the
+    smart client's ring-staleness detection and PR 4's 429 pacing both
+    read them)."""
     if code < 400:
         return
     try:
@@ -85,9 +92,11 @@ def _raise_for_status(code: int, body: bytes,
     except (ValueError, UnicodeDecodeError):
         status = {}
     message = status.get("message", body.decode("latin-1")[:200])
-    raise _status_error(code, status.get("reason", ""), message,
+    err = _status_error(code, status.get("reason", ""), message,
                         details=status.get("details"),
                         retry_after=retry_after)
+    err.http_headers = headers or {}
+    raise err
 
 
 class RestWatch:
@@ -99,12 +108,16 @@ class RestWatch:
     """
 
     def __init__(self, host: str, port: int, path: str, resource: str,
-                 token: str = "", ssl_context=None):
+                 token: str = "", ssl_context=None,
+                 extra_headers: dict[str, str] | None = None):
         self._host = host
         self._port = port
         self._path = path
         self._token = token
         self._ssl = ssl_context
+        # extra request headers (the smart client's X-Kcp-Ring-Epoch
+        # stamp on direct-to-shard watches rides here)
+        self._extra_headers = extra_headers or {}
         self.resource = resource
         self._events: asyncio.Queue[Event | None] = asyncio.Queue()
         self._task: asyncio.Task | None = None
@@ -133,9 +146,11 @@ class RestWatch:
                 server_hostname=self._host if self._ssl else None)
             auth = (f"Authorization: Bearer {self._token}\r\n"
                     if self._token else "")
+            extra = "".join(f"{k}: {v}\r\n"
+                            for k, v in self._extra_headers.items())
             writer.write(
                 f"GET {self._path} HTTP/1.1\r\nHost: {self._host}\r\n"
-                f"{auth}Connection: close\r\n\r\n".encode())
+                f"{auth}{extra}Connection: close\r\n\r\n".encode())
             await writer.drain()
             head = await reader.readuntil(b"\r\n\r\n")
             status_line = head.split(b"\r\n", 1)[0].decode("latin-1")
@@ -143,10 +158,21 @@ class RestWatch:
             self.responded = True
             if code >= 400:
                 body = await reader.read(64 * 1024)
+                # response headers ride the error (err.http_headers) so
+                # a direct-to-shard watch refusal keeps its ring-epoch
+                # stamp, exactly like the request path
+                hdrs: dict[str, str] = {}
+                for hline in head.split(b"\r\n")[1:]:
+                    if b":" in hline:
+                        hk, _, hv = hline.partition(b":")
+                        hdrs[hk.decode("latin-1").strip().lower()] = \
+                            hv.decode("latin-1").strip()
                 # strip chunked framing if present; _raise_for_status just
                 # needs the JSON Status body
                 try:
-                    _raise_for_status(code, body[body.find(b"{"):body.rfind(b"}") + 1])
+                    _raise_for_status(
+                        code, body[body.find(b"{"):body.rfind(b"}") + 1],
+                        headers=hdrs)
                 except errors.ApiError as e:
                     self.error = e
                 return
@@ -340,7 +366,10 @@ class RestClient:
         self._conn: http.client.HTTPConnection | None = None
 
     def scoped(self, cluster: str) -> "RestClient":
-        c = RestClient.__new__(RestClient)
+        # type(self), not RestClient: a subclass's scoped clones keep the
+        # subclass behavior (a SmartRestClient's clones must keep routing
+        # direct — the shared ring state rides the __dict__ copy)
+        c = type(self).__new__(type(self))
         c.__dict__.update(self.__dict__)  # _discovered + _disc_lock shared
         c.cluster = cluster
         c._conn = None  # connections are per-instance; ssl ctx is shared
@@ -448,14 +477,22 @@ class RestClient:
                 {"method": method, "path": path.partition("?")[0][:160],
                  "status": status})
         retry_after = None
-        if status == 429:
-            # a throttling answer is the peer ALIVE (the breaker saw
-            # record_success above); surface the pacing hint instead
-            try:
-                retry_after = float(resp.getheader("Retry-After") or "")
-            except ValueError:
-                pass
-        _raise_for_status(status, data, retry_after=retry_after)
+        rheaders = None
+        if status >= 400:
+            # error responses keep their headers on the raised ApiError
+            # (err.http_headers): Retry-After pacing and the shard's
+            # X-Kcp-Ring-Epoch stamp must survive the raise so the smart
+            # client's fallback sees them on the direct path too
+            rheaders = {k.lower(): v for k, v in resp.getheaders()}
+            if status == 429:
+                # a throttling answer is the peer ALIVE (the breaker saw
+                # record_success above); surface the pacing hint instead
+                try:
+                    retry_after = float(rheaders.get("retry-after") or "")
+                except ValueError:
+                    pass
+        _raise_for_status(status, data, retry_after=retry_after,
+                          headers=rheaders)
         return json.loads(data) if data else None
 
     def request_raw(self, method: str, target: str,

@@ -81,6 +81,18 @@ class Config:
     # primary's breaker stays open past the hysteresis window)
     shards: str = ""  # router role: comma-separated [name=]url shard list
     # (KCP_SHARDS env is the fallback; see kcp_tpu/sharding/ring.py)
+    shard_name: str = ""  # shard role: this server's stable name in the
+    # ring (KCP_SHARD_NAME env fallback). With ring_names set, direct
+    # smart-client requests (X-Kcp-Ring-Epoch stamped) are verified
+    # against HRW ownership: a stale-ring client gets a typed 410
+    # instead of a silently-wrong shard's answer
+    ring_names: str = ""  # shard role: comma-separated names of EVERY
+    # shard in the ring (KCP_RING_NAMES env fallback) — names alone
+    # determine HRW ownership, so a shard can verify direct requests
+    # without knowing anyone's address
+    ring_epoch: int = 0  # shard role: the ring epoch this shard was
+    # (re)started under; stamped on ring-mismatch 410s so smart clients
+    # can tell a stale shard from a stale self
     primary: str = ""  # replica/standby roles: the primary's base URL
     # (the /replication/wal feed source and the health-probe target).
     # Accepts a comma-separated CANDIDATE list ("url1,url2"): a replica
@@ -249,6 +261,23 @@ class Server:
             # anyway), so its admission chain would be dead weight; a
             # standby keeps the default chain for life after promotion
             admission=(None if self.config.role == "replica" else "auto"))
+        # smart-client ring identity (env fallbacks let subprocess fleets
+        # configure shards without new flags in every harness)
+        shard_name = (self.config.shard_name
+                      or os.environ.get("KCP_SHARD_NAME", ""))
+        ring_names = (self.config.ring_names
+                      or os.environ.get("KCP_RING_NAMES", ""))
+        if shard_name and ring_names:
+            names = tuple(n.strip() for n in ring_names.split(",")
+                          if n.strip())
+            if shard_name not in names:
+                raise ValueError(
+                    f"--shard-name {shard_name!r} is not in --ring-names "
+                    f"{sorted(names)}")
+            self.handler.shard_name = shard_name
+            self.handler.ring_names = names
+            self.handler.ring_epoch = self.config.ring_epoch or int(
+                os.environ.get("KCP_RING_EPOCH", "1") or "1")
         self._wire_replication()
         self.certs = None
         ssl_context = None

@@ -45,6 +45,16 @@ def _weight(shard_name: str, cluster: str) -> int:
     return int.from_bytes(h.digest(), "big")
 
 
+def owner_name(names, cluster: str) -> str:
+    """HRW owner of ``cluster`` given only the ring's shard NAMES.
+
+    Ownership depends on the name set alone (URLs never enter the
+    hash), so a shard that knows the ring's names and its own name can
+    verify a smart client's direct request without knowing anyone's
+    address — the server half of the ``X-Kcp-Ring-Epoch`` handshake."""
+    return max(names, key=lambda n: (_weight(n, cluster), n))
+
+
 class ShardRing:
     """An ordered, deduplicated set of shards with HRW ownership."""
 

@@ -235,6 +235,18 @@ class RouterHandler:
         self._primary_fails = [0] * len(ring)
         self._last_probe = [0.0] * len(ring)
         self._retired: list[ConnectionPool] = []
+        # ring epoch: bumped on every ring change (POST /ring republish,
+        # promotion rehome) — smart clients stamp it on direct requests
+        # and re-fetch GET /ring when anything disagrees
+        self.ring_epoch = 1
+        # pool construction knobs, kept for set_ring rebuilds
+        self._pool_kw = dict(token=token, ca_data=ca_data, ca_file=ca_file,
+                             cap=cap)
+        self._raw_chunks = REGISTRY.counter(
+            "router_raw_relay_chunks_total",
+            "watch-stream chunks forwarded by the zero-parse single-"
+            "cluster relay (length-delimited framing only — payload "
+            "bytes never decoded, split, or parsed)")
 
     def close(self) -> None:
         self._exec.shutdown(wait=False, cancel_futures=True)
@@ -245,6 +257,55 @@ class RouterHandler:
                 p.close()
         for p in self._retired:
             p.close()
+
+    # ------------------------------------------------------------ /ring
+
+    def _ring_doc(self) -> dict:
+        """The smart-client handshake document: the current ring and its
+        epoch — everything a client needs to compute HRW owners locally
+        and go direct."""
+        return {
+            "epoch": self.ring_epoch,
+            "shards": [{"name": s.name, "url": s.url,
+                        "replicas": list(s.replicas)}
+                       for s in self.ring.shards],
+        }
+
+    def set_ring(self, ring: ShardRing) -> None:
+        """Swap the serving ring in place (the ``POST /ring`` republish
+        after a shard moves to a new address): pools for unchanged URLs
+        carry over, pools for departed URLs retire (closed at
+        handler.close — in-flight relays may still hold their clients),
+        and the ring epoch bumps so smart clients re-fetch."""
+        with self._rehome_lock:
+            old_by_url = {p.base_url: p for p in self._pools}
+            old_r_by_url = {p.base_url: p
+                            for rp in self._rpools for p in rp}
+            pools: list[ConnectionPool] = []
+            rpools: list[list[ConnectionPool]] = []
+            for s in ring:
+                pools.append(old_by_url.pop(s.url, None)
+                             or ConnectionPool(s.url, **self._pool_kw))
+                rp = []
+                for url in s.replicas:
+                    rp.append(old_r_by_url.pop(url, None)
+                              or ConnectionPool(url, **self._pool_kw))
+                rpools.append(rp)
+            self._retired.extend(old_by_url.values())
+            self._retired.extend(
+                p for p in old_r_by_url.values()
+                if all(p not in rp for rp in rpools))
+            # whole-slot assignments: concurrent relays hold consistent
+            # snapshots of the old lists
+            self.ring = ring
+            self._pools = pools
+            self._rpools = rpools
+            self._rr = [0] * len(ring)
+            self._primary_fails = [0] * len(ring)
+            self._last_probe = [0.0] * len(ring)
+            self.ring_epoch += 1
+        log.warning("ring republished (epoch %d): %s", self.ring_epoch,
+                    [f"{s.name}={s.url}" for s in ring])
 
     # ----------------------------------------------------------- plumbing
 
@@ -339,6 +400,16 @@ class RouterHandler:
             # calls may still hold its clients (closed at handler.close)
             self._retired.append(old)
             self._primary_fails[idx] = 0
+            # the ring itself re-points at the promoted primary and the
+            # epoch bumps: smart clients going direct to the dead URL
+            # fall back once, re-fetch /ring, and follow the promotion
+            s = self.ring.shards[idx]
+            shards = list(self.ring.shards)
+            shards[idx] = type(s)(
+                s.name, promoted.base_url,
+                tuple(u for u in s.replicas if u != promoted.base_url))
+            self.ring = ShardRing(shards)
+            self.ring_epoch += 1
         self._rehomes.inc()
         log.warning("shard %s: write routing re-homed %s -> %s "
                     "(promoted replica)", self.ring.shards[idx].name,
@@ -449,6 +520,11 @@ class RouterHandler:
                                                "application/json"))
         if "retry-after" in lower:
             resp.headers["Retry-After"] = lower["retry-after"]
+        if "x-kcp-ring-epoch" in lower:
+            # a shard's ring-mismatch stamp passes through untouched:
+            # a routed-but-smart-aware client sees the same staleness
+            # signal it would on the direct path
+            resp.headers["X-Kcp-Ring-Epoch"] = lower["x-kcp-ring-epoch"]
         return resp
 
     @staticmethod
@@ -546,6 +622,30 @@ class RouterHandler:
                 return Response(body=b"ok", content_type="text/plain")
             return Response(status=500, body=b"not ready",
                             content_type="text/plain")
+        if head == "ring":
+            # the smart-client handshake surface: GET serves the current
+            # ring + epoch; POST republishes it (the operator/driver move
+            # after a shard restarts on a new address)
+            if req.method == "GET":
+                return Response.of_json(self._ring_doc())
+            if req.method == "POST":
+                try:
+                    body = json.loads(req.body) if req.body else {}
+                    spec = body.get("shards", "")
+                    if isinstance(spec, list):
+                        spec = ",".join(
+                            f"{s['name']}={s['url']}"
+                            + "".join("|" + r
+                                      for r in s.get("replicas", ()))
+                            for s in spec)
+                    ring = ShardRing.from_spec(spec)
+                except (ValueError, KeyError, TypeError) as e:
+                    return _error_response(errors.BadRequestError(
+                        f"malformed ring spec: {e}"))
+                self.set_ring(ring)
+                return Response.of_json(self._ring_doc())
+            return _error_response(errors.BadRequestError(
+                f"unsupported method {req.method} for /ring"))
         if head == "metrics":
             if req.param("fleet") in ("1", "true"):
                 return await self._metrics_fleet(req)
@@ -881,39 +981,80 @@ class RouterHandler:
 
     def _stream_proxy(self, idx: int, target: str, req: Request,
                       pool: ConnectionPool | None = None) -> StreamResponse:
-        """Single-cluster watch: a byte-verbatim stream relay to the
-        owning shard — every line (events, bookmarks, in-stream errors)
-        passes through untouched, so resume RVs stay shard-local and
-        honest (the ring maps the cluster back to the same shard).
-        ``pool`` targets a read replica for fresh watches."""
+        """Single-cluster watch: a ZERO-PARSE stream relay to the owning
+        shard — upstream length-delimited chunks forward verbatim (size
+        line + payload bytes untouched: no utf-8 decode, no line split,
+        no per-event json parse — the ``_TapWatch`` parse survives only
+        on merged wildcard watches, which genuinely need per-shard
+        positions). Resume RVs stay shard-local and honest (the ring
+        maps the cluster back to the same shard). ``pool`` targets a
+        read replica for fresh watches."""
         shard = self.ring.shards[idx]
+        use = pool if pool is not None else self._pools[idx]
+        parts = urlsplit(use.base_url)
+        host = parts.hostname or "127.0.0.1"
+        port = parts.port or (443 if parts.scheme == "https" else 80)
+        auth = req.headers.get("authorization", "")
+        token = auth[7:] if auth.lower().startswith("bearer ") else use.token
+        ssl_ctx = use.ssl_context
+        tp = self._fwd_headers(req).get(obs.TRACEPARENT)
 
         async def produce(stream: StreamResponse) -> None:
-            watch = self._tap_watch(idx, target, req, pool=pool)
+            reader = writer = None
             try:
+                try:
+                    reader, writer = await asyncio.open_connection(
+                        host, port, ssl=ssl_ctx,
+                        server_hostname=host if ssl_ctx else None)
+                except (ConnectionError, OSError) as e:
+                    self._unavailable.inc()
+                    await stream.send_json({
+                        "type": "ERROR",
+                        "object": _status_body(
+                            503, "ServiceUnavailable",
+                            f"shard {shard.name} unreachable: {e}")})
+                    return
+                lines = [f"GET {target} HTTP/1.1", f"Host: {host}"]
+                if token:
+                    lines.append(f"Authorization: Bearer {token}")
+                if tp:
+                    lines.append(f"{obs.TRACEPARENT}: {tp}")
+                lines.append("Connection: close")
+                writer.write(("\r\n".join(lines) + "\r\n\r\n").encode())
+                await writer.drain()
+                head = await reader.readuntil(b"\r\n\r\n")
+                code = int(head.split(b"\r\n", 1)[0].split(b" ")[1])
+                if code >= 400:
+                    # the shard refused the watch: relay its Status
+                    # in-stream like every other relay refusal
+                    body = await reader.read(64 * 1024)
+                    raw = body[body.find(b"{"):body.rfind(b"}") + 1]
+                    try:
+                        status = json.loads(raw)
+                    except ValueError:
+                        status = _status_body(
+                            code, "",
+                            f"shard {shard.name} refused the watch "
+                            f"({code})")
+                    await stream.send_json({"type": "ERROR",
+                                            "object": status})
+                    return
                 while True:
-                    item = await watch.next()
-                    if item is None:
-                        err = watch.error
-                        if err is not None:
-                            # non-2xx upstream response: surface it
-                            # in-stream like every other relay refusal
-                            await stream.send_json({
-                                "type": "ERROR",
-                                "object": _status_body(err.code, err.reason,
-                                                       err.message)})
-                        return
-                    batch = [item[0]]
-                    batch.extend(raw for raw, _m in watch.drain_raw())
-                    await stream.send_raw_many(batch)
-            except errors.UnavailableError as e:
-                self._unavailable.inc()
-                await stream.send_json({
-                    "type": "ERROR",
-                    "object": _status_body(503, "ServiceUnavailable",
-                                           f"shard {shard.name}: {e.message}")})
+                    size_line = await reader.readline()
+                    if not size_line:
+                        return  # upstream died: clean end, client resumes
+                    size = int(size_line.strip() or b"0", 16)
+                    if size == 0:
+                        return  # upstream terminal chunk: clean end
+                    payload = await reader.readexactly(size + 2)
+                    self._raw_chunks.inc()
+                    await stream.relay_chunk(size_line, payload)
+            except (ConnectionError, asyncio.IncompleteReadError, OSError,
+                    ValueError):
+                return  # stream garbled or torn down mid-relay
             finally:
-                watch.close()
+                if writer is not None:
+                    writer.close()
 
         return StreamResponse(produce)
 

@@ -12,8 +12,9 @@ history windows — are enforced once, by the backend's real store; this
 class is a transport, not a second implementation.
 
 Division of labor when a frontend serves this way:
-- reads/writes/watches pass through (one RestClient per logical cluster,
-  kept-alive; watches ride the ndjson stream);
+- reads/writes/watches pass through a bounded :class:`ConnectionPool`
+  whose kept-alive connections are re-scoped per borrow (one socket
+  serves every tenant; watches ride the ndjson stream);
 - the frontend runs NO WAL and takes no snapshots (``snapshot`` is a
   no-op) — durability is the backend's;
 - controllers: run them on exactly one process (usually the backend;
@@ -30,10 +31,10 @@ backend's response) — informer relists handle both shapes.
 from __future__ import annotations
 
 import contextlib
+import os
 import threading
-from collections import OrderedDict
 
-from ..analysis.sanitize import make_lock
+from ..utils.errors import UnavailableError
 from .selectors import LabelSelector
 from .store import WILDCARD
 
@@ -42,31 +43,46 @@ DEFAULT_CLUSTER = "default"
 
 class ConnectionPool:
     """Bounded pool of RestClients for ONE peer (a shard behind the
-    router, a storage backend): each client owns one kept-alive
-    connection and is not thread-safe, so concurrency = clients. All
-    clients are ``scoped()`` clones of one prototype, which makes the
-    per-peer circuit breaker and the discovery cache SHARED — a dead
-    peer trips once and every borrowed client fails fast.
+    router, a storage backend, a smart client's direct shard): each
+    client owns one kept-alive connection and is not thread-safe, so
+    concurrency = clients. All clients are ``scoped()`` clones of one
+    prototype, which makes the per-peer circuit breaker and the
+    discovery cache SHARED — a dead peer trips once and every borrowed
+    client fails fast.
 
-    ``client()`` is a context manager: borrow (blocking once ``cap``
-    clients are all in flight — backpressure instead of unbounded
-    sockets), use, return. Used by the shard router for scatter-gather
-    fan-out, where N shards × M concurrent requests would otherwise
-    serialize on one connection per shard."""
+    ``client(cluster=...)`` is a context manager: borrow (blocking once
+    every in-flight slot is taken — backpressure instead of unbounded
+    sockets), use, return. Passing ``cluster`` re-scopes the borrowed
+    client in place: the SAME kept-alive connection serves every
+    logical-cluster scope over its lifetime (connection reuse across
+    scoped clones — a frontend asked about 10k tenants holds ``cap``
+    sockets, not 10k).
+
+    ``depth`` (``KCP_ROUTER_POOL_DEPTH``, default 1) is the burst
+    multiplexing knob: up to ``cap × depth`` borrows may be in flight at
+    once. The first ``cap`` ride the kept-alive pooled connections;
+    bursts beyond that get transient clients whose connections close on
+    return — bounded socket growth under fan-out spikes instead of a
+    30 s borrow stall. ``depth=1`` is exactly the legacy blocking pool."""
 
     def __init__(self, base_url: str, token: str = "",
                  ca_data: bytes | str | None = None,
                  ca_file: str | None = None, cap: int = 8,
-                 cluster: str = WILDCARD):
+                 cluster: str = WILDCARD, depth: int | None = None):
         # deferred import: store/ must not import server/ at module load
         from ..server.rest import RestClient
 
         self._proto = RestClient(base_url, cluster=cluster, token=token,
                                  ca_data=ca_data, ca_file=ca_file)
         self._cap = max(1, cap)
+        if depth is None:
+            depth = int(os.environ.get("KCP_ROUTER_POOL_DEPTH", "1") or "1")
+        self._depth = max(1, depth)
+        self._max_inflight = self._cap * self._depth
         self._cond = threading.Condition()
         self._free = [self._proto]
-        self._total = 1
+        self._total = 1          # pooled (kept-alive) clients created
+        self._inflight = 0       # borrows currently outstanding
         self._closed = False
         self.base_url = base_url
 
@@ -84,23 +100,44 @@ class ConnectionPool:
         return self._proto.token
 
     @contextlib.contextmanager
-    def client(self):
+    def client(self, cluster: str | None = None):
+        transient = False
         with self._cond:
-            while not self._free and self._total >= self._cap:
+            if self._closed:
+                # a retired/closed pool must not mint fresh sockets —
+                # typed so the router's fail-fast path and the smart
+                # client's fallback both handle it like a dead peer
+                raise UnavailableError(
+                    f"connection pool for {self.base_url} is closed")
+            while (not self._free and self._total >= self._cap
+                   and self._inflight >= self._max_inflight):
                 if not self._cond.wait(timeout=30):
                     raise TimeoutError(
                         f"connection pool for {self.base_url} exhausted "
-                        f"({self._cap} clients all in flight for 30s)")
+                        f"({self._max_inflight} borrows all in flight "
+                        f"for 30s)")
             if self._free:
                 c = self._free.pop()
-            else:
+            elif self._total < self._cap:
                 c = self._proto.scoped(self._proto.cluster)
                 self._total += 1
+            else:
+                # burst beyond the kept-alive core (depth > 1): a
+                # transient clone — same breaker/discovery, its own
+                # connection, closed on return
+                c = self._proto.scoped(self._proto.cluster)
+                transient = True
+            self._inflight += 1
+        if cluster is not None and c.cluster != cluster:
+            # connection reuse across scoped clones: re-scope in place —
+            # the borrow is exclusive, so mutating the clone is safe
+            c.cluster = cluster
         try:
             yield c
         finally:
             with self._cond:
-                if self._closed:
+                self._inflight -= 1
+                if self._closed or transient:
                     c.close()
                 else:
                     self._free.append(c)
@@ -126,27 +163,20 @@ class RemoteStore:
     def __init__(self, base_url: str, token: str = "",
                  ca_data: bytes | str | None = None,
                  ca_file: str | None = None):
-        # deferred import: store/ must not import server/ at module load
-        # (server imports store)
-        from ..server.rest import RestClient
-
-        self._root = RestClient(base_url, cluster=WILDCARD, token=token,
-                                ca_data=ca_data, ca_file=ca_file)
         # Callers run verbs from a thread pool (the handler's store-I/O
         # executor), but each RestClient owns ONE kept-alive connection
-        # and is not thread-safe — so every entry pairs a client with a
-        # lock, concurrency comes from different clusters proceeding in
-        # parallel, and the LRU map itself is guarded by _map_lock.
-        # Bounded so a frontend asked about arbitrarily many tenants
-        # doesn't leak a socket per tenant. The discovery cache the
-        # scoped clients share is the one piece of cross-entry state;
-        # RestClient guards it with its own _disc_lock (no GIL
-        # assumption — see rest.py), so per-entry locks stay strictly
-        # about the connection.
-        self._map_lock = make_lock("remote.scope_map")
-        self._scoped: "OrderedDict[str, tuple[object, threading.Lock]]" = (
-            OrderedDict({WILDCARD: (self._root, make_lock("remote.scoped_conn"))}))
-        self._scoped_cap = 256
+        # and is not thread-safe — so verbs borrow from a bounded
+        # ConnectionPool and re-scope the borrowed client to the target
+        # cluster in place. One connection serves EVERY tenant scope
+        # over its lifetime (the pre-PR 13 shape held a kept-alive
+        # socket per tenant in a 256-entry LRU; a frontend asked about
+        # 10k tenants now holds `cap` sockets, period). The discovery
+        # cache and the per-peer circuit breaker are shared across the
+        # pool's clones by RestClient.scoped's own contract.
+        self._pool = ConnectionPool(
+            base_url, token=token, ca_data=ca_data, ca_file=ca_file,
+            cap=int(os.environ.get("KCP_ROUTER_POOL", "8") or "8"),
+            cluster=WILDCARD)
         self.base_url = base_url
         # LogicalStore duck-type attributes the handler/client read
         self.openapi_doc: dict | None = None
@@ -154,36 +184,9 @@ class RemoteStore:
 
     # ---------------------------------------------------------- plumbing
 
-    def _entry(self, cluster: str):
-        with self._map_lock:
-            e = self._scoped.get(cluster)
-            if e is None:
-                e = (self._root.scoped(cluster), make_lock("remote.scoped_conn"))
-                self._scoped[cluster] = e
-                if len(self._scoped) > self._scoped_cap:
-                    key, (evicted, elock) = self._scoped.popitem(last=False)
-                    if key == WILDCARD:
-                        # the root entry is load-bearing (RV/cluster
-                        # probes) — never evict it: re-insert as
-                        # most-recent and take the true oldest instead
-                        self._scoped[WILDCARD] = (evicted, elock)
-                        key, (evicted, elock) = self._scoped.popitem(last=False)
-                    # close only if idle; a client mid-request keeps its
-                    # socket until GC finalizes it (never yank a
-                    # connection out from under another thread)
-                    if elock.acquire(blocking=False):
-                        try:
-                            evicted.close()
-                        finally:
-                            elock.release()
-            else:
-                self._scoped.move_to_end(cluster)
-            return e
-
     def _call(self, cluster: str, verb: str, *args, **kwargs):
-        client, lock = self._entry(cluster)
-        with lock:
-            return getattr(client, verb)(*args, **kwargs)
+        with self._pool.client(cluster) as c:
+            return getattr(c, verb)(*args, **kwargs)
 
     # ------------------------------------------------------------- verbs
 
@@ -210,8 +213,7 @@ class RemoteStore:
 
     def delete(self, resource: str, cluster: str, name: str,
                namespace: str = "") -> None:
-        client, lock = self._entry(cluster)
-        with lock:
+        with self._pool.client(cluster) as client:
             if cluster == WILDCARD:
                 # RestClient refuses wildcard deletes (an in-process
                 # store needs an explicit tenant), but here the backend's
@@ -242,8 +244,7 @@ class RemoteStore:
 
     @property
     def resource_version(self) -> int:
-        client, lock = self._entry(WILDCARD)
-        with lock:
+        with self._pool.client(WILDCARD) as client:
             body = client._request("GET", "/version")
         if "resourceVersion" not in body:
             # an authz'd backend withholds the RV from tokens lacking the
@@ -259,8 +260,7 @@ class RemoteStore:
         return self._call(WILDCARD, "resources")
 
     def clusters(self) -> list[str]:
-        client, lock = self._entry(WILDCARD)
-        with lock:
+        with self._pool.client(WILDCARD) as client:
             body = client._request("GET", "/clusters")
         return list(body.get("clusters", []))
 
@@ -275,8 +275,4 @@ class RemoteStore:
         """No-op: durability belongs to the backend's store."""
 
     def close(self) -> None:
-        with self._map_lock:
-            entries = list(self._scoped.values())
-        for client, lock in entries:
-            with lock:
-                client.close()
+        self._pool.close()

@@ -190,6 +190,41 @@ print("sharded smoke ok: capacity %sx @2 shards (concurrent %sx on %s cpu)"
          kill["watch_410_ms"], kill["failfast_ms"], kill["acked_writes"]))
 '
 
+echo "== smartclient: direct-vs-routed smoke (2-shard fleet, byte equality, ring-change drill)"
+# smart clients compute the HRW owner from GET /ring and skip the
+# router hop. Floors: direct single-cluster write CAPACITY (per-shard
+# time slices summed — see docs/operations.md "Benchmarking") >=1.5x
+# the one-router routed ceiling (the committed BENCH_r08 measured
+# 3.7x @2 shards), routed and direct
+# responses byte-identical, the scatter wire path sha256-identical to
+# the join path, and the mid-bench ring-change drill (shard drains,
+# restarts on a NEW port, /ring republishes, all under an injected
+# router.proxy fault schedule) completing with zero lost acked writes
+# and zero surfaced client errors — one-shot fallbacks absorb the move.
+smart_line=$(KCP_BENCH_SMART_SECONDS=1.5 KCP_BENCH_SMART_CLUSTERS=8 \
+    python bench.py --smartclient | tail -1)
+printf '%s\n' "$smart_line" | python -c '
+import json, sys
+r = json.loads(sys.stdin.readline())
+sb = r["smartclient_bench"]
+ab, wire, drill = sb["ab"], sb["wire"], sb["ring_change_drill"]
+assert r["value"] >= 1.5, "direct/routed capacity %sx < 1.5x floor" % r["value"]
+assert ab["bytes_equal"], "routed vs direct responses diverged"
+assert ab["direct_requests"] > 0, "smart client never went direct: %s" % ab
+assert wire["identical"], "scatter wire path diverged from join path"
+assert wire["spans_written"] > 0, "scatter path never exercised: %s" % wire
+assert drill["lost_after_move"] == 0, "acked writes lost in ring change: %s" % drill
+assert drill["errors_surfaced"] == 0, "client errors surfaced in drill: %s" % drill
+assert drill["fallbacks"] >= 1 and drill["ring_epoch_after"] >= 2, drill
+print("smartclient smoke ok: %sx direct/routed capacity (p99 %s->%sms) | bytes equal"
+      " | wire scatter identical (%d spans, %d bytes join-free)"
+      " | ring-change drill: %d acked / 0 lost, %d fallbacks, epoch %d"
+      % (r["value"], ab["routed_p99_ms"], ab["direct_p99_ms"],
+         wire["spans_written"], wire["join_avoided_bytes"],
+         drill["acked_writes"], drill["fallbacks"],
+         drill["ring_epoch_after"]))
+'
+
 echo "== replica: HA replication smoke (read scaling, lag, kill-the-primary drill)"
 # primary + 0/1/2 WAL-fed read replicas, then a durable primary+standby
 # kill drill. Floors: read capacity >=1.5x at 2 replicas (each endpoint
@@ -303,7 +338,7 @@ echo "== scenarios: seeded end-to-end chaos smoke (churn + reconnect storm + kil
 # files; the full catalog (incl. rolling-restart drain-vs-kill) runs
 # via `scripts/scenarios.py run --all --seed 42`.
 JAX_PLATFORMS=cpu python scripts/scenarios.py run \
-    --scenarios crud-churn,reconnect-storm,kill-primary \
+    --scenarios crud-churn,reconnect-storm,kill-primary,ring-change-under-load \
     --seed 42 --scale 0.4 --out SCENARIOS_smoke.json
 python -c '
 import json

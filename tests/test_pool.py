@@ -1,0 +1,155 @@
+"""ConnectionPool unit tests (store/remote.py).
+
+The pool sits on every router relay, replica read, remote-store verb,
+and smart-client direct hop — yet until this file it had no dedicated
+coverage. The contracts pinned here:
+
+- connection reuse across scoped clones: one borrowed client (= one
+  kept-alive socket) serves many logical-cluster scopes over its
+  lifetime, re-scoped in place per borrow;
+- bounded size: at most ``cap`` pooled clients exist no matter how many
+  sequential borrows happen, and ``cap × depth`` bounds concurrent
+  borrows (transients beyond the kept-alive core close on return);
+- breaker sharing: every borrowed client (pooled or transient) shares
+  the ONE per-peer circuit breaker, so a dead peer trips once;
+- close-on-handler-close: a closed pool closes its idle clients and
+  closes in-flight clients on return instead of pooling them.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from kcp_tpu.store.remote import ConnectionPool
+from kcp_tpu.store.store import WILDCARD
+
+
+def _pool(**kw) -> ConnectionPool:
+    # nothing listens here: these tests exercise borrow/return
+    # bookkeeping, never the wire
+    return ConnectionPool("http://127.0.0.1:9", **kw)
+
+
+def test_scoped_clone_connection_reuse():
+    """Sequential borrows for DIFFERENT clusters hand back the same
+    client object (the same kept-alive connection), re-scoped in
+    place — the socket-per-tenant LRU this replaced held one socket
+    per cluster."""
+    pool = _pool(cap=4)
+    with pool.client("tenant-a") as c1:
+        assert c1.cluster == "tenant-a"
+        first = c1
+    with pool.client("tenant-b") as c2:
+        assert c2 is first          # same client, same connection
+        assert c2.cluster == "tenant-b"  # new scope
+    with pool.client() as c3:
+        assert c3 is first          # no cluster: scope left as-is
+        assert c3.cluster == "tenant-b"
+    pool.close()
+
+
+def test_bounded_size_and_depth_transients():
+    """Concurrent borrows are bounded by cap × depth: the first ``cap``
+    ride pooled clients, bursts beyond that get transient clones, and
+    a borrow past the bound blocks."""
+    pool = _pool(cap=2, depth=2)
+    held = []
+    with pool.client("a") as c1, pool.client("b") as c2:
+        held = [c1, c2]
+        assert c1 is not c2
+        # burst slots: transients share nothing but breaker/discovery
+        with pool.client("c") as c3, pool.client("d") as c4:
+            assert c3 not in held and c4 not in held
+            # 4 borrows in flight = cap*depth: the 5th must block
+            got = threading.Event()
+
+            def fifth():
+                try:
+                    with pool.client("e"):
+                        got.set()
+                except TimeoutError:
+                    pass
+
+            t = threading.Thread(target=fifth, daemon=True)
+            t.start()
+            time.sleep(0.15)
+            assert not got.is_set(), "5th borrow should block at cap*depth"
+        # two slots freed: the blocked borrow proceeds
+        assert got.wait(5.0)
+        t.join(5.0)
+    # after every return, at most `cap` clients are pooled
+    assert len(pool._free) <= 2
+    assert pool._total <= 2
+    pool.close()
+
+
+def test_depth_default_is_legacy_blocking_pool():
+    """depth=1 (the default): in-flight bound == cap, exactly the
+    pre-knob behavior."""
+    pool = _pool(cap=1, depth=1)
+    with pool.client("a"):
+        blocked = threading.Event()
+        done = threading.Event()
+
+        def second():
+            blocked.set()
+            try:
+                with pool.client("b"):
+                    done.set()
+            except TimeoutError:
+                pass
+
+        t = threading.Thread(target=second, daemon=True)
+        t.start()
+        blocked.wait(2.0)
+        time.sleep(0.15)
+        assert not done.is_set()
+    assert done.wait(5.0)
+    t.join(5.0)
+    pool.close()
+
+
+def test_breaker_shared_across_all_borrows():
+    """Pooled and transient clients alike share the pool's ONE breaker:
+    a dead peer trips once for everyone."""
+    pool = _pool(cap=1, depth=3)
+    with pool.client("a") as c1, pool.client("b") as c2:
+        assert c1._breaker is pool.breaker
+        assert c2._breaker is pool.breaker  # transient shares it too
+        assert c1._discovered is c2._discovered  # and the discovery map
+    pool.close()
+
+
+def test_close_on_handler_close():
+    """close() closes idle clients immediately and in-flight clients on
+    return — nothing is pooled after close, and late borrows fail
+    rather than hand out sockets from a closed pool."""
+    pool = _pool(cap=2)
+    with pool.client("a") as held:
+        pool.close()
+        # the in-flight client still works for its holder...
+        assert held.cluster == "a"
+    # ...but was closed on return, not re-pooled
+    assert pool._free == []
+    assert held._conn is None
+    from kcp_tpu.utils.errors import UnavailableError
+
+    with pytest.raises(UnavailableError):
+        with pool.client("b"):
+            raise AssertionError("borrow from a closed pool must not work")
+    pool.close()  # idempotent
+
+
+def test_wildcard_default_scope():
+    """The prototype's default scope is the wildcard (RemoteStore's
+    root probes list across tenants); a scoped borrow never leaks its
+    scope back into an explicitly-wildcard borrow."""
+    pool = _pool(cap=1)
+    with pool.client("tenant-z"):
+        pass
+    with pool.client(WILDCARD) as c:
+        assert c.cluster == WILDCARD
+    pool.close()
