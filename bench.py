@@ -2461,6 +2461,368 @@ def replica_bench() -> int:
     return 0
 
 
+def writes_bench() -> int:
+    """Write-path group commit A/B (``--writes``): serial
+    (``KCP_GROUP_COMMIT=0``) vs grouped (``=1``) at 1/16/64/256
+    concurrent writers under honest per-commit durability
+    (``KCP_WAL_SYNC=fsync`` by default — the cost the commit window
+    exists to amortize).
+
+    Two measurement altitudes. The HEADLINE (``value``) is the
+    **write-path component**: concurrent writer tasks driving
+    ``store.create`` + the durability barrier directly on one event
+    loop — the mutation + WAL append + sync + fan-out work the tentpole
+    batches, with no HTTP serving overhead diluting it (median of 3
+    trials per lane; the same altitude discipline as ``--store`` /
+    ``--encode``). The **end-to-end** lanes run the same A/B through
+    real HTTP serving (threads x RestClient against a ServerThread) and
+    are reported alongside — on a 1-cpu host request serving dominates
+    there, so the ratio is honest-but-smaller. Plus: (1) a seeded
+    sequential CRUD equality pass — serial and grouped final state
+    byte-identical modulo per-process identity fields
+    (uid/creationTimestamp; the store-level fuzz in
+    tests/test_group_commit.py pins those and proves FULL byte equality
+    incl. the WAL), with identical RV sequences; (2) the
+    kill-mid-window drill — durable primary + semi-sync standby,
+    SIGKILL mid-storm, offline WAL replay must carry every acked write.
+    ``value`` is the grouped/serial write-path ratio at 64 writers.
+    """
+    import hashlib
+    import tempfile
+    import threading
+
+    from kcp_tpu.server.rest import RestClient
+    from kcp_tpu.server.server import Config
+    from kcp_tpu.server.threaded import ServerThread
+    from kcp_tpu.store.store import LogicalStore
+    from kcp_tpu.utils.trace import REGISTRY
+
+    seconds = float(os.environ.get("KCP_BENCH_WRITES_SECONDS", "1.5"))
+    concs = [int(x) for x in os.environ.get(
+        "KCP_BENCH_WRITES_CONC", "1,16,64,256").split(",") if x.strip()]
+    sync_mode = os.environ.get("KCP_BENCH_WRITES_SYNC", "fsync")
+    eq_ops = int(os.environ.get("KCP_BENCH_WRITES_EQ_OPS", "400"))
+    drill_writers = int(os.environ.get("KCP_BENCH_WRITES_DRILL_CONC", "8"))
+    store_ops = int(os.environ.get("KCP_BENCH_WRITES_STORE_OPS", "200"))
+    _raise_nofile()
+
+    def cm(name: str, cluster: str, data: str = "") -> dict:
+        return {"apiVersion": "v1", "kind": "ConfigMap",
+                "metadata": {"name": name, "namespace": "default",
+                             "clusterName": cluster}, "data": {"v": data}}
+
+    def pctile(vals: list[float], q: float) -> float:
+        if not vals:
+            return 0.0
+        s = sorted(vals)
+        return s[max(0, min(len(s) - 1, int(q * len(s)) - 1))]
+
+    def spawn(root: str, grouped: bool, role: str = "",
+              primary: str = "") -> ServerThread:
+        # the store reads KCP_GROUP_COMMIT/KCP_WAL_SYNC at construction:
+        # patch only for the constructor window (scenario-topology
+        # discipline), restore after
+        saved = {k: os.environ.get(k)
+                 for k in ("KCP_GROUP_COMMIT", "KCP_WAL_SYNC",
+                           "KCP_FLOW_CONCURRENCY")}
+        os.environ["KCP_GROUP_COMMIT"] = "1" if grouped else "0"
+        os.environ["KCP_WAL_SYNC"] = sync_mode
+        # flow control off: a 1-writer lane would saturate one tenant's
+        # default token rate and measure throttling, not the write path
+        # (bench.py --admission owns the flow-control story)
+        os.environ["KCP_FLOW_CONCURRENCY"] = "0"
+        try:
+            kw: dict = dict(durable=True, install_controllers=False,
+                            tls=False, root_dir=root)
+            if role:
+                kw.update(role=role, primary=primary,
+                          repl_hysteresis_s=30.0)
+            return ServerThread(Config(**kw)).start()
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    def hammer(address: str, writers: int, secs: float
+               ) -> tuple[int, list[float], int]:
+        """N writer threads creating as fast as acks return; returns
+        (acked, per-write latencies, errors)."""
+        lock = threading.Lock()
+        acked = [0]
+        errs = [0]
+        lats: list[float] = []
+        stop_at = time.perf_counter() + secs
+        start = threading.Barrier(writers + 1)
+
+        def work(wi: int) -> None:
+            c = RestClient(address, cluster=f"t{wi % 8}")
+            i = 0
+            my: list[float] = []
+            n = e = 0
+            start.wait()
+            try:
+                while time.perf_counter() < stop_at:
+                    t0 = time.perf_counter()
+                    try:
+                        c.create("configmaps",
+                                 cm(f"w{wi}-{i}", f"t{wi % 8}", str(i)))
+                        n += 1
+                        my.append(time.perf_counter() - t0)
+                    except Exception:
+                        e += 1
+                    i += 1
+            finally:
+                c.close()
+            with lock:
+                acked[0] += n
+                errs[0] += e
+                lats.extend(my)
+
+        threads = [threading.Thread(target=work, args=(i,))
+                   for i in range(writers)]
+        for t in threads:
+            t.start()
+        start.wait()
+        t0 = time.perf_counter()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        return int(acked[0] / max(dt, 1e-9)), lats, errs[0]
+
+    # ------------------------------------ write-path component (headline)
+    def store_lane(grouped: bool, conc: int) -> tuple[float, list[float]]:
+        """One trial: conc writer tasks on one loop, store.create + the
+        durability barrier; returns (writes/s, latencies)."""
+        os.environ["KCP_GROUP_COMMIT"] = "1" if grouped else "0"
+        os.environ["KCP_WAL_SYNC"] = sync_mode
+        # comparable sample sizes per lane: low-concurrency lanes get
+        # proportionally more ops per writer so a 1-writer trial is not
+        # a 50ms noise measurement
+        per_writer = store_ops * max(1, 64 // max(conc, 1))
+        with tempfile.TemporaryDirectory() as root:
+            store = LogicalStore(wal_path=os.path.join(root, "w.wal"))
+
+            async def drive():
+                async def writer(wi: int) -> list[float]:
+                    lat: list[float] = []
+                    for i in range(per_writer):
+                        t0 = time.perf_counter()
+                        store.create("configmaps", f"t{wi % 8}",
+                                     cm(f"w{wi}-{i}", f"t{wi % 8}", str(i)))
+                        aw = store.commit_durable(store.resource_version)
+                        if aw is not None:
+                            await aw
+                        else:
+                            await asyncio.sleep(0)
+                        lat.append(time.perf_counter() - t0)
+                    return lat
+
+                t0 = time.perf_counter()
+                per = await asyncio.gather(
+                    *(writer(i) for i in range(conc)))
+                dt = time.perf_counter() - t0
+                return conc * per_writer / dt, [x for ls in per for x in ls]
+
+            rps, lats = asyncio.run(drive())
+            store.close()
+        return rps, lats
+
+    saved_env = {k: os.environ.get(k)
+                 for k in ("KCP_GROUP_COMMIT", "KCP_WAL_SYNC")}
+    path_lanes: dict[str, dict] = {}
+    try:
+        for mode, grouped in (("serial", False), ("grouped", True)):
+            path_lanes[mode] = {}
+            for n in concs:
+                trials = [store_lane(grouped, n) for _ in range(3)]
+                trials.sort(key=lambda t: t[0])
+                rps, lats = trials[1]  # median by throughput
+                path_lanes[mode][str(n)] = {
+                    "rps": round(rps),
+                    "p50_ms": round(pctile(lats, 0.50) * 1e3, 3),
+                    "p99_ms": round(pctile(lats, 0.99) * 1e3, 3),
+                }
+                print(f"write-path {mode} x{n}: {round(rps)} w/s  p99 "
+                      f"{path_lanes[mode][str(n)]['p99_ms']}ms",
+                      file=sys.stderr, flush=True)
+    finally:
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    # --------------------------------------- end-to-end HTTP serving lanes
+    lanes: dict[str, dict] = {}
+    for mode, grouped in (("serial", False), ("grouped", True)):
+        lanes[mode] = {}
+        for n in concs:
+            with tempfile.TemporaryDirectory() as root:
+                srv = spawn(root, grouped)
+                try:
+                    rps, lats, errors = hammer(srv.address, n, seconds)
+                finally:
+                    srv.stop()
+            lanes[mode][str(n)] = {
+                "rps": rps, "errors": errors,
+                "p50_ms": round(pctile(lats, 0.50) * 1e3, 3),
+                "p99_ms": round(pctile(lats, 0.99) * 1e3, 3),
+            }
+            print(f"writes http {mode} x{n}: {rps} acks/s  "
+                  f"p99 {lanes[mode][str(n)]['p99_ms']}ms "
+                  f"({errors} errors)", file=sys.stderr, flush=True)
+
+    # ------------------------------------------------ equality (A/B state)
+    def equality_pass(grouped: bool) -> tuple[str, list[int]]:
+        """One seeded sequential CRUD stream; returns (state digest
+        modulo identity fields, rv sequence)."""
+        rng = np.random.default_rng(7)
+        rvs: list[int] = []
+        with tempfile.TemporaryDirectory() as root:
+            srv = spawn(root, grouped)
+            try:
+                c = RestClient(srv.address, cluster="t0")
+                live: set[str] = set()
+                for i in range(eq_ops):
+                    name = f"eq{int(rng.integers(eq_ops // 4))}"
+                    kind = int(rng.integers(3))
+                    try:
+                        if kind == 0 or name not in live:
+                            out = c.create("configmaps",
+                                           cm(name, "t0", str(i)))
+                            live.add(name)
+                        elif kind == 1:
+                            cur = c.get("configmaps", name, "default")
+                            cur["data"] = {"v": str(i)}
+                            out = c.update("configmaps", cur)
+                        else:
+                            c.delete("configmaps", name, "default")
+                            live.discard(name)
+                            out = None
+                    except Exception:
+                        out = None
+                    if out is not None:
+                        rvs.append(int(out["metadata"]["resourceVersion"]))
+                items, rv = c.list("configmaps", "default")
+                stripped = [
+                    {**o, "metadata": {
+                        k: v for k, v in o["metadata"].items()
+                        if k not in ("uid", "creationTimestamp")}}
+                    for o in items]
+                digest = hashlib.sha256(json.dumps(
+                    [rv, stripped], sort_keys=True).encode()).hexdigest()
+                c.close()
+            finally:
+                srv.stop()
+        return digest, rvs
+
+    d_serial, rv_serial = equality_pass(grouped=False)
+    d_grouped, rv_grouped = equality_pass(grouped=True)
+    state_equal = d_serial == d_grouped and rv_serial == rv_grouped
+
+    # ------------------------------------------ kill-mid-window drill
+    win0 = REGISTRY.counter("store_commit_windows_total").value
+    ack0 = REGISTRY.counter("repl_ack_batched_total").value
+    drill_root = tempfile.mkdtemp(prefix="kcp-writes-drill-")
+    p = spawn(os.path.join(drill_root, "p"), grouped=True)
+    s = spawn(os.path.join(drill_root, "s"), grouped=True,
+              role="standby", primary=p.address)
+    acked_names: list[str] = []
+    lock = threading.Lock()
+
+    # storm bounded in time, not ops: the kill must land mid-storm, and
+    # a slow server teardown must not stretch the drill indefinitely
+    drill_deadline = time.perf_counter() + max(0.5, seconds / 3) + 3.0
+
+    def drill_writer(wi: int) -> None:
+        c = RestClient(p.address, cluster="t1")
+        try:
+            for i in range(100_000):
+                if time.perf_counter() > drill_deadline:
+                    return
+                name = f"dr{wi}-{i}"
+                try:
+                    c.create("configmaps", cm(name, "t1", str(i)))
+                except Exception:
+                    return  # dead primary: unacked by definition
+                with lock:
+                    acked_names.append(name)
+        finally:
+            c.close()
+
+    storm = [threading.Thread(target=drill_writer, args=(i,))
+             for i in range(drill_writers)]
+    for t in storm:
+        t.start()
+    time.sleep(max(0.5, seconds / 3))
+    p.kill()  # SIGKILL-equivalent: mid-window, no compaction
+    for t in storm:
+        t.join(timeout=30)
+    s.stop()
+    windows = REGISTRY.counter("store_commit_windows_total").value - win0
+    acks_batched = REGISTRY.counter("repl_ack_batched_total").value - ack0
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "walreplay", os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                  "scripts", "walreplay.py"))
+    walreplay = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(walreplay)
+    st = walreplay.replay(os.path.join(drill_root, "p", "store.wal"))
+    have = {key.decode().split("\x00")[3] for key in st.objects}
+    lost = [nm for nm in acked_names if nm not in have]
+    drill = {
+        "writers": drill_writers,
+        "acked_writes": len(acked_names),
+        "lost_after_kill": len(lost),
+        "commit_windows": windows,
+        "acks_batched": acks_batched,
+        "ok": not lost and windows > 0 and len(acked_names) > 0,
+    }
+
+    at = str(64 if 64 in concs else max(concs))
+    base = max(path_lanes["serial"][at]["rps"], 1)
+    http_base = max(lanes["serial"][at]["rps"], 1)
+    out = {
+        "metric": "write_group_commit_speedup",
+        "value": round(path_lanes["grouped"][at]["rps"] / base, 2),
+        "unit": "x",
+        "stage": "writes-bench",
+        "writes_bench": {
+            "host_cpus": os.cpu_count(),
+            "seconds": seconds,
+            "wal_sync": sync_mode,
+            "concurrency": concs,
+            "write_path": {
+                "serial": path_lanes["serial"],
+                "grouped": path_lanes["grouped"],
+                "speedup": {
+                    str(n): round(
+                        path_lanes["grouped"][str(n)]["rps"]
+                        / max(path_lanes["serial"][str(n)]["rps"], 1), 2)
+                    for n in concs},
+            },
+            "end_to_end_http": {
+                "serial": lanes["serial"],
+                "grouped": lanes["grouped"],
+                "speedup_at_top": round(
+                    lanes["grouped"][at]["rps"] / http_base, 2),
+            },
+            "p99_1_writer_ms": {
+                "serial": path_lanes["serial"].get("1", {}).get("p99_ms"),
+                "grouped": path_lanes["grouped"].get("1", {}).get("p99_ms"),
+            },
+            "state_equal": state_equal,
+            "rv_sequence_equal": rv_serial == rv_grouped,
+            "kill_drill": drill,
+        },
+    }
+    emit(out)
+    return 0
+
+
 # ---------------------------------------------------------------------------
 # Orchestrator: the TPU rides a tunnel that wedges transiently, and a hung
 # in-process backend init cannot be interrupted from within. So the default
@@ -3596,7 +3958,7 @@ if __name__ == "__main__":
     if ("--store" in args or "--admission" in args or "--encode" in args
             or "--sharded" in args or "--replica" in args
             or "--watchers" in args or "--trace" in args
-            or "--smartclient" in args):
+            or "--smartclient" in args or "--writes" in args):
         # pure-host microbenches: pin CPU (never touch the tunnel)
         # and run in-process — no watchdog child needed
         try:
@@ -3612,6 +3974,7 @@ if __name__ == "__main__":
                  else watchers_bench() if "--watchers" in args
                  else trace_bench() if "--trace" in args
                  else smartclient_bench() if "--smartclient" in args
+                 else writes_bench() if "--writes" in args
                  else encode_bench())
     if "--probe" in args:
         # manual diagnostic: always run in-process (never through the

@@ -46,6 +46,10 @@ class _NoopTicket:
     def fail(self) -> None:
         pass
 
+    def split_for_window(self):
+        """Group-commit form of settle: nothing to defer."""
+        return None, None
+
 
 NOOP_TICKET = _NoopTicket()
 
@@ -68,6 +72,12 @@ class FastTicket:
         self._release()
 
     fail = ok
+
+    def split_for_window(self):
+        """Group-commit form of settle: free the flow slot now (the
+        window linger must not hold concurrency), nothing to defer."""
+        self._release()
+        return None, None
 
 
 class Ticket:
@@ -100,6 +110,20 @@ class Ticket:
             self._reservation.rollback()
         if self._release is not None:
             self._release()
+
+    def split_for_window(self):
+        """Group-commit form of settle: free the flow slot NOW and hand
+        the stateful half — (quota reservation, after-hook) — to the
+        caller's commit window, which settles a whole window's
+        reservations in one batched ledger pass
+        (admission/quota.settle_batch). Marks the ticket done: the
+        window owns the rest."""
+        if self._done:
+            return None, None
+        self._done = True
+        if self._release is not None:
+            self._release()
+        return self._reservation, self._after
 
 
 class DefaultingPlugin:

@@ -107,6 +107,25 @@ class Reservation:
         self._ledger._release(self._idx, self._delta, rollback)
 
 
+def settle_batch(reservations, rollback: bool = False) -> None:
+    """Settle one commit window's reservations with ONE ledger lock
+    acquisition per ledger — the group-commit form of
+    :meth:`Reservation.commit` / :meth:`Reservation.rollback`. Already-
+    settled (or None) entries are skipped, matching the per-reservation
+    idempotence."""
+    by_ledger: dict[int, tuple["QuotaLedger", list[tuple[int, int]]]] = {}
+    for r in reservations:
+        if r is None or r._done:
+            continue
+        r._done = True
+        ent = by_ledger.get(id(r._ledger))
+        if ent is None:
+            ent = by_ledger[id(r._ledger)] = (r._ledger, [])
+        ent[1].append((r._idx, r._delta))
+    for ledger, items in by_ledger.values():
+        ledger._release_batch(items, rollback)
+
+
 class QuotaLedger:
     """Vectorized (cluster, resource) usage/limit ledger.
 
@@ -200,6 +219,24 @@ class QuotaLedger:
             REGISTRY.counter(
                 "quota_rollback_total",
                 "quota reservations rolled back (failed writes)").inc()
+
+    def _release_batch(self, items: list[tuple[int, int]],
+                       rollback: bool) -> None:
+        """One commit window's reservation releases under one lock
+        acquisition (:func:`settle_batch`)."""
+        with self._lock:
+            for i, delta in items:
+                self._reserved[i] -= delta
+        if rollback and items:
+            REGISTRY.counter(
+                "quota_rollback_total",
+                "quota reservations rolled back (failed writes)").inc(
+                len(items))
+        REGISTRY.counter(
+            "quota_window_settled_total",
+            "quota reservations settled by a batched per-commit-window "
+            "ledger pass instead of one lock round trip per write").inc(
+            len(items))
 
     # -------------------------------------------------------- usage hook
 

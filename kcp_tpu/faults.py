@@ -36,6 +36,12 @@ every time).
 Injection points wired in this codebase:
 
     store.put / store.get / store.list / store.delete   store/store.py
+    store.commit_window          store/store.py group-commit window flush
+                                 (``drop`` = force a window split mid-
+                                 fill; ``error``/``raise`` = the window's
+                                 WAL sync fails — every writer parked on
+                                 the window gets a typed 5xx and NONE of
+                                 its records commit)
     watch                        store Watch + server/rest.py RestWatch
     watch.evict                  store/store.py Watch._push (``drop`` =
                                  force-evict the watcher as if its
@@ -110,6 +116,7 @@ POINTS = frozenset({
     "store.get",
     "store.list",
     "store.delete",
+    "store.commit_window",
     "watch",
     "watch.evict",
     "rest.request",

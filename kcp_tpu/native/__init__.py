@@ -63,6 +63,12 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.ws_count.argtypes = [ctypes.c_void_p]
     lib.ws_flush.restype = ctypes.c_int
     lib.ws_flush.argtypes = [ctypes.c_void_p]
+    lib.ws_batch_begin.restype = ctypes.c_int
+    lib.ws_batch_begin.argtypes = [ctypes.c_void_p]
+    lib.ws_batch_commit.restype = ctypes.c_int
+    lib.ws_batch_commit.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.ws_batch_abort.restype = ctypes.c_int
+    lib.ws_batch_abort.argtypes = [ctypes.c_void_p]
     lib.ws_epoch.restype = ctypes.c_uint64
     lib.ws_epoch.argtypes = [ctypes.c_void_p]
     lib.ws_set_epoch.restype = ctypes.c_int
@@ -194,6 +200,28 @@ class WalEngine:
 
     def __len__(self) -> int:
         return self._lib.ws_count(self._h)
+
+    def append_batch(self, ops, fsync: bool = False) -> None:
+        """Append one group-commit window of records as ONE buffered
+        write + at most one fsync. ``ops`` is an iterable of
+        ``(key, val, rv)`` tuples — ``val is None`` means delete. With
+        ``fsync=False`` the engine's ``sync_every`` batching still
+        applies (the KCP_WAL_SYNC=flush policy); a failed commit leaves
+        NONE of the window's records in the log."""
+        lib = self._lib
+        if lib.ws_batch_begin(self._h) != 0:
+            raise OSError(lib.ws_last_error(self._h).decode())
+        try:
+            for key, val, rv in ops:
+                if val is None:
+                    self.delete(key, rv)
+                else:
+                    self.put(key, val, rv)
+        except BaseException:
+            lib.ws_batch_abort(self._h)
+            raise
+        if lib.ws_batch_commit(self._h, 1 if fsync else 0) != 0:
+            raise OSError(lib.ws_last_error(self._h).decode())
 
     def flush(self) -> None:
         if self._lib.ws_flush(self._h) != 0:

@@ -70,6 +70,10 @@ class ReplicationHub:
         self._next_sid = 1
         self._acked: dict[int, int] = {}  # standby sid -> applied rv
         self._waiters: list[tuple[int, asyncio.Future]] = []
+        # semi-sync waiters coalesce per RV: every writer of one commit
+        # window parks on the window's HIGH RV, so N writers share ONE
+        # future and one standby ack releases them all (one RTT/window)
+        self._wait_futs: dict[int, asyncio.Future] = {}
         self.sync_timeout_s = sync_timeout_s
         self._shipped = REGISTRY.counter(
             "repl_ship_records_total",
@@ -81,7 +85,12 @@ class ReplicationHub:
             "repl_sync_degraded_total",
             "writes acknowledged without standby confirmation because "
             "the semi-sync wait timed out")
-        store.set_repl_hook(self.commit)
+        self._ack_batched = REGISTRY.counter(
+            "repl_ack_batched_total",
+            "semi-sync waiters that parked on an already-waiting commit "
+            "window RV — writes released by a shared standby ack instead "
+            "of their own round trip")
+        store.set_repl_hook(self.commit, self.commit_batch)
 
     # ------------------------------------------------------------- commit
 
@@ -96,6 +105,24 @@ class ReplicationHub:
             for sub in self._subs.values():
                 sub.q.put_nowait(line)
             self._shipped.inc(len(self._subs))
+
+    def commit_batch(self, recs: list[dict]) -> None:
+        """Store batch hook: one flushed commit window. The resume
+        window keeps per-RV lines (reconnect tails bisect by RV), but
+        live subscribers get the whole window as ONE queue push — the
+        feed writes it as one chunk, the follower applies it as one
+        batch and answers ONE ack at the window's high RV."""
+        lines = []
+        for rec in recs:
+            rv = int(rec.get("rv", 0) or self.store.resource_version)
+            line = json.dumps(rec, separators=(",", ":")).encode() + b"\n"
+            self._records.append((rv, line))
+            lines.append(line)
+        if self._subs and lines:
+            blob = b"".join(lines)
+            for sub in self._subs.values():
+                sub.q.put_nowait(blob)
+            self._shipped.inc(len(lines) * len(self._subs))
 
     # ------------------------------------------------------ subscriptions
 
@@ -139,9 +166,11 @@ class ReplicationHub:
         still: list[tuple[int, asyncio.Future]] = []
         for rv, fut in self._waiters:
             if fut.done():
+                self._wait_futs.pop(rv, None)
                 continue
             if floor is None or floor >= rv:
                 fut.set_result(True)
+                self._wait_futs.pop(rv, None)
             else:
                 still.append((rv, fut))
         self._waiters = still
@@ -149,16 +178,26 @@ class ReplicationHub:
     async def wait_committed(self, rv: int) -> bool:
         """Semi-sync commit: wait until every attached standby has
         applied ``rv``. Returns immediately when no standby is attached
-        (async replication — the WAL is the durability story). On
-        timeout the write is acknowledged anyway, degraded and counted:
-        a lagging standby must not take primary availability hostage."""
+        (async replication — the WAL is the durability story). Waiters
+        at the same RV share one future (a commit window's writers all
+        park at the window's high RV — one standby ack releases the
+        whole window, counted ``repl_ack_batched_total``). On timeout
+        the write is acknowledged anyway, degraded and counted: a
+        lagging standby must not take primary availability hostage."""
         floor = self._sync_floor()
         if floor is None or floor >= rv:
             return True
-        fut = asyncio.get_running_loop().create_future()
-        self._waiters.append((rv, fut))
+        fut = self._wait_futs.get(rv)
+        if fut is None or fut.done():
+            fut = asyncio.get_running_loop().create_future()
+            self._wait_futs[rv] = fut
+            self._waiters.append((rv, fut))
+        else:
+            self._ack_batched.inc()
         try:
-            await asyncio.wait_for(fut, timeout=self.sync_timeout_s)
+            # shield: the shared future must survive one waiter's timeout
+            await asyncio.wait_for(asyncio.shield(fut),
+                                   timeout=self.sync_timeout_s)
             return True
         except asyncio.TimeoutError:
             self._degraded.inc()

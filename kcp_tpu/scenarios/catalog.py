@@ -203,8 +203,36 @@ RING_CHANGE = ScenarioSpec(
     ),
 )
 
+WRITE_STORM = ScenarioSpec(
+    name="write-storm",
+    description="The whole tenant fleet writes flat-out with group "
+                "commit on (KCP_GROUP_COMMIT=1, the default) and the "
+                "primary is SIGKILLed mid-storm behind a router with "
+                "standby + replica: the standby promotes and zero "
+                "ACKED writes are lost — an unsynced commit window was "
+                "never acked, so grouping cannot widen the loss window "
+                "— while the commit-window counters prove the write "
+                "path actually grouped under the storm.",
+    topology="replicated",
+    tenants=6,
+    watchers_per_tenant=1,
+    env={"KCP_GROUP_COMMIT": "1"},
+    phases=(Phase("warm", ops_per_tenant=20),
+            Phase("storm", ops_per_tenant=120, action="kill_primary",
+                  settle_s=1.5),
+            Phase("recovered", ops_per_tenant=20, settle_s=1.0)),
+    options={"pace_s": 0.0, "coverage_timeout_s": 30.0},
+    slos=(
+        SLO("no-lost-acked-writes", "lost_acked_writes", "==", 0),
+        SLO("standby-promoted", "repl_promotions", ">=", 1),
+        SLO("writes-actually-grouped", "store_commit_windows", ">=", 1),
+        SLO("no-lost-watch-events", "lost_watch_events", "==", 0),
+        SLO("error-budget-5xx", "http_5xx", "<=", 2000),
+    ),
+)
+
 SCENARIOS: dict[str, ScenarioSpec] = {
     s.name: s for s in (CRUD_CHURN, NOISY_NEIGHBOR, RECONNECT_STORM,
                         ROLLING_RESTART, KILL_PRIMARY, CRD_CHURN,
-                        RING_CHANGE)
+                        RING_CHANGE, WRITE_STORM)
 }

@@ -52,7 +52,9 @@ log = logging.getLogger(__name__)
 TRACKED_COUNTERS = ("repl_promotions_total", "repl_rehome_total",
                     "router_rehome_total", "smart_client_direct_total",
                     "smart_client_fallback_total",
-                    "smart_client_ring_refreshes_total")
+                    "smart_client_ring_refreshes_total",
+                    "store_commit_windows_total",
+                    "repl_ack_batched_total")
 
 
 def pctile(vals: list[float], q: float) -> float:

@@ -148,6 +148,10 @@ class RestHandler:
         self.repl_applier = None
         self.repl_role = "primary"
         self.repl_lag_max = 0
+        # group-commit admission batching: commit-window future -> the
+        # enrolled writes' (quota reservation, after-hook) pairs; settled
+        # in ONE ledger pass when the window resolves (_settle_adm_window)
+        self._adm_windows: dict = {}
         # graceful drain (Server.drain): once set, every live watch
         # producer flushes its buffered events, sends a terminal
         # in-stream Status, and returns — the half of "no watcher is
@@ -641,8 +645,7 @@ class RestHandler:
             except BaseException:
                 ticket.fail()
                 raise
-            ticket.ok()
-            await self._repl_wait()
+            await self._finish_write(ticket)
             return Response.of_json(self._stamp(created, info, gv), 201)
 
         if req.method == "PUT" and name is not None:
@@ -670,8 +673,7 @@ class RestHandler:
             except BaseException:
                 ticket.fail()
                 raise
-            ticket.ok()
-            await self._repl_wait()
+            await self._finish_write(ticket)
             return Response.of_json(self._stamp(updated, info, gv))
 
         if req.method == "DELETE" and name is not None:
@@ -689,8 +691,7 @@ class RestHandler:
             except BaseException:
                 ticket.fail()
                 raise
-            ticket.ok()
-            await self._repl_wait()
+            await self._finish_write(ticket)
             return Response.of_json(_status_body(200, "Deleted", f"{res} {name} deleted"))
 
         raise errors.BadRequestError(f"unsupported method {req.method} for {req.path}")
@@ -907,15 +908,76 @@ class RestHandler:
         return _error_response(
             errors.NotFoundError(f"unknown path {req.path}"))
 
-    async def _repl_wait(self) -> None:
+    async def _finish_write(self, ticket) -> None:
+        """Release one write's HTTP ack: durability barrier first (the
+        commit window's shared WAL sync — a window that dies pre-sync
+        fails every writer typed and acks none), then the semi-sync
+        standby wait at the window's high RV (one ack releases every
+        writer of the window). The admission ticket settles with the
+        same cadence: serial writes settle inline; windowed writes free
+        their flow slot immediately but batch the quota reserve→commit
+        into ONE ledger pass per window (admission/quota.settle_batch).
+        """
+        st = self.store
+        # lazy on rv: remote-store frontends price resource_version as a
+        # backend round trip, and they have neither windows nor a hub
+        wait = getattr(st, "commit_durable", None)
+        aw = wait() if wait is not None else None
+        if aw is None:
+            ticket.ok()
+            rv = None
+        else:
+            self._enroll_ticket(aw, ticket)
+            rv = await aw  # window high RV; typed 503 on a failed sync
+        await self._repl_wait(rv)
+
+    def _enroll_ticket(self, fut, ticket) -> None:
+        """Park one write's admission obligations on its commit window:
+        the flow slot frees NOW (window linger must not throttle
+        concurrency), the quota reservation + after-hooks settle once
+        per window when the shared future resolves."""
+        split = getattr(ticket, "split_for_window", None)
+        if split is None:
+            ticket.ok()  # foreign ticket shape: settle inline
+            return
+        reservation, after = split()
+        if reservation is None and after is None:
+            return
+        batch = self._adm_windows.get(fut)
+        if batch is None:
+            batch = self._adm_windows[fut] = []
+            fut.add_done_callback(self._settle_adm_window)
+        batch.append((reservation, after))
+
+    def _settle_adm_window(self, fut) -> None:
+        """One commit window resolved: settle every enrolled write's
+        quota reservation in one batched ledger pass (commit on a
+        durable window, rollback on a failed sync — 'commit none'
+        applies to the ledger too) and fire the after-hooks."""
+        batch = self._adm_windows.pop(fut, None)
+        if not batch:
+            return
+        from ..admission.quota import settle_batch
+
+        ok = not fut.cancelled() and fut.exception() is None
+        settle_batch([r for r, _ in batch], rollback=not ok)
+        if ok:
+            for _, after in batch:
+                if after is not None:
+                    after()
+
+    async def _repl_wait(self, rv: int | None = None) -> None:
         """Semi-sync commit: with a standby attached, a write is only
-        acknowledged once the standby has applied it — the property the
-        kill-the-primary drill measures as zero acknowledged-write
-        loss. No standby, no wait (async replication)."""
+        acknowledged once the standby has applied ``rv`` (the write's
+        own RV, or its commit window's high RV so the whole window rides
+        one ack) — the property the kill-the-primary drill measures as
+        zero acknowledged-write loss. No standby, no wait (async
+        replication)."""
         hub = self.repl_hub
         if hub is not None and hub.has_sync_subscribers:
             with obs.span("repl.ack"):
-                await hub.wait_committed(self.store.resource_version)
+                await hub.wait_committed(
+                    rv or self.store.resource_version)
 
     def _check_replica_lag(self) -> None:
         """Reads on a replica past KCP_REPL_LAG_MAX refuse 503 — for

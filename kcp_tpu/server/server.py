@@ -511,8 +511,14 @@ class Server:
                 log.warning("drain: in-flight requests still running at "
                             "the %.1fs deadline", timeout)
             # 3. flush + terminate watchers and replication subscribers.
-            # The store's pending fan-out is flushed FIRST so the watch
-            # producers' final drain() sees every committed event.
+            # An open commit window is flushed FIRST (group commit: a
+            # reconciler's last writes may still be buffered — their
+            # records must ship BEFORE the hub's drain sentinel), then
+            # the store's pending fan-out, so the watch producers' final
+            # drain() sees every committed event.
+            if self.store is not None and hasattr(self.store,
+                                                  "_gc_barrier"):
+                self.store._gc_barrier()
             if self.store is not None and hasattr(self.store,
                                                   "_flush_events"):
                 self.store._flush_events()

@@ -80,6 +80,30 @@ Watcher scale (PR 11):
   arrays (rebuilt only when the watch set changes), so a flush is
   O(events + deliveries), not O(live watchers).
 
+Write path: group commit (KCP_GROUP_COMMIT=1, the default):
+
+- concurrent mutations apply to the in-memory state one at a time as
+  always (RV allocation, conflict checks, event emission unchanged),
+  but their WAL records coalesce into a bounded **commit window**
+  (KCP_COMMIT_WINDOW_MAX rows / KCP_COMMIT_WINDOW_US linger; 0 = close
+  at the next loop pass) whose flush is ONE buffered WAL append + ONE
+  KCP_WAL_SYNC-policy flush/fsync (both backends — the native engine's
+  ws_batch_begin/commit), ONE replication batch, and ONE watch fan-out
+  flush;
+- writers needing a durability barrier await :meth:`commit_durable`,
+  which resolves with the window's high RV after the sync (the serving
+  layer parks every writer's semi-sync standby wait there — one ack
+  per window) — with an idle fast path that flushes synchronously when
+  nothing else can join, so a lone writer pays the serial path's
+  latency;
+- a window whose sync fails fails every parked writer with a typed 503
+  and commits NONE of its records (``store.commit_window`` faults
+  drill the split/failure/abort paths); sync-context callers (no
+  running loop) keep the serial append — durable on return;
+- ``KCP_GROUP_COMMIT=0`` keeps the serial path as the A/B reference:
+  state, event streams and WAL bytes are identical either way
+  (tests/test_group_commit.py differential fuzz; bench.py --writes).
+
 Thread-model: single-threaded synchronous core intended to be called from
 one asyncio event loop; watches buffer into deques and optionally notify an
 asyncio.Event so async consumers can await new events.
@@ -140,6 +164,70 @@ def _env_watch_queue() -> int:
     turns into a terminal in-stream typed 410 (informers relist-NOW and
     resume) — instead of buffering the window into unbounded memory."""
     return int(os.environ.get("KCP_WATCH_QUEUE", "65536"))
+
+
+def _env_group_commit() -> bool:
+    """Group commit (KCP_GROUP_COMMIT, default on): concurrent mutations
+    coalesce into one commit window — the window's WAL records append as
+    ONE buffered write + ONE sync, ship to replication as ONE batch, and
+    fan out to watchers in ONE flush. ``=0`` keeps the serial
+    append-per-record path (the A/B reference; byte-identical WAL/state
+    either way)."""
+    return os.environ.get("KCP_GROUP_COMMIT", "1").lower() not in (
+        "0", "false", "off")
+
+
+def _env_commit_window_max() -> int:
+    """Commit-window row bound (KCP_COMMIT_WINDOW_MAX): a window holding
+    this many records flushes immediately instead of waiting out the
+    linger — bounds both ack latency and the blast radius of one failed
+    sync."""
+    return max(1, int(os.environ.get("KCP_COMMIT_WINDOW_MAX", "256")))
+
+
+def _env_commit_window_us() -> float:
+    """Commit-window linger (KCP_COMMIT_WINDOW_US, microseconds). ``0``
+    (the default) closes the window at the next event-loop pass — every
+    mutation already runnable this pass joins it, so the idle case pays
+    one loop iteration, not a timer. ``>0`` holds the window open that
+    long to accumulate more writers per sync at the cost of added write
+    latency."""
+    return max(0.0, float(os.environ.get("KCP_COMMIT_WINDOW_US", "0")))
+
+
+def _env_wal_sync() -> str:
+    """WAL sync policy (KCP_WAL_SYNC): what one commit (window or serial
+    record) costs in durability terms.
+
+    - ``flush`` (default): python/user-space buffers flushed to the OS
+      per commit; the native engine keeps its legacy ``sync_every``
+      batched fsync. Survives process death, NOT power loss.
+    - ``fsync``: fsync per commit — full durability; group commit is
+      what makes this affordable (one fsync per window, not per write).
+    - ``off``: no explicit flush at all; the OS (and python's buffer)
+      decide. Maximum throughput, weakest guarantee.
+    """
+    mode = os.environ.get("KCP_WAL_SYNC", "flush").lower()
+    if mode not in ("flush", "fsync", "off"):
+        raise InvalidError(
+            f"unknown KCP_WAL_SYNC {mode!r} (flush|fsync|off)")
+    return mode
+
+
+class _CommitWindow:
+    """One open group-commit window: the records awaiting their shared
+    WAL append + sync, the future every writer of the window parks on
+    (resolved with the window's high RV after a successful sync; the
+    typed sync error otherwise), and the scheduled flush callback."""
+
+    __slots__ = ("recs", "fut", "high_rv", "handle", "flushed")
+
+    def __init__(self, fut: "asyncio.Future"):
+        self.recs: list[dict] = []
+        self.fut = fut
+        self.high_rv = 0
+        self.handle = None
+        self.flushed = False
 
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
@@ -556,6 +644,38 @@ class LogicalStore:
         self._engine = None
         self._engine_mutations = 0
         self._engine_snapshot_every = 50_000
+        # WAL sync policy (KCP_WAL_SYNC=flush|fsync|off): read before the
+        # engine opens — fsync/off take over sync scheduling explicitly,
+        # so the engine's own sync_every batching is disabled for them
+        self._wal_sync = _env_wal_sync()
+        # group commit (KCP_GROUP_COMMIT, default on): concurrent
+        # mutations coalesce into a bounded commit window that appends as
+        # ONE buffered write + ONE sync, ships ONE replication batch, and
+        # fires ONE watch fan-out flush. Windows only form on stores with
+        # a sink (WAL or replication hook) under a running event loop;
+        # sync-context callers keep the serial path record for record.
+        self._gc_enabled = _env_group_commit()
+        self._gc_max = _env_commit_window_max()
+        self._gc_linger_s = _env_commit_window_us() / 1e6
+        self._gc_window: _CommitWindow | None = None
+        self._gc_windows_total = REGISTRY.counter(
+            "store_commit_windows_total",
+            "group-commit windows flushed (one WAL append + one sync + "
+            "one replication batch + one fan-out flush each)")
+        self._gc_window_size = REGISTRY.histogram(
+            "store_commit_window_size",
+            "mutations coalesced per group-commit window",
+            buckets=SIZE_BUCKETS)
+        self._wal_sync_total = REGISTRY.counter(
+            "wal_sync_total",
+            "explicit WAL flush/fsync operations (KCP_WAL_SYNC policy); "
+            "group commit amortizes these across a whole window")
+        self._wal_sync_seconds = REGISTRY.histogram(
+            "wal_sync_seconds",
+            "time spent in one WAL durable append + flush/fsync call")
+        # batched replication hook: set alongside _repl_hook — a flushed
+        # window ships once through this instead of once per record
+        self._repl_batch = None
         if wal_backend not in ("auto", "native", "json"):
             raise InvalidError(f"unknown wal_backend {wal_backend!r} (auto|native|json)")
         if wal_path:
@@ -584,7 +704,13 @@ class LogicalStore:
                 try:
                     from ..native import WalEngine
 
-                    self._engine = WalEngine(wal_path, sync_every=wal_sync_every)
+                    # flush (default) keeps the engine's legacy batched
+                    # fsync; fsync/off schedule syncs explicitly (per
+                    # record / per window / never), so the engine's own
+                    # sync_every counter is disabled for them
+                    eng_sync = (wal_sync_every
+                                if self._wal_sync == "flush" else 0)
+                    self._engine = WalEngine(wal_path, sync_every=eng_sync)
                 except Exception:
                     if wal_backend == "native":
                         raise
@@ -1323,6 +1449,13 @@ class LogicalStore:
         if len(self._pending) >= self._emit_batch:
             self._flush_events()
         elif not self._flush_scheduled:
+            if self._gc_sink():
+                # group commit: this mutation's _log_wal joins (or
+                # opens) a commit window, whose flush delivers the
+                # fan-out once for the whole window — no per-mutation
+                # scheduling (watch()/drain() still flush lazily, and
+                # sync-context callers never scheduled here anyway)
+                return
             try:
                 loop = asyncio.get_running_loop()
             except RuntimeError:
@@ -1585,21 +1718,220 @@ class LogicalStore:
 
     # ---------------------------------------------------------- durability
 
-    def set_repl_hook(self, hook) -> None:
+    def set_repl_hook(self, hook, batch=None) -> None:
         """Install the per-commit replication callback ``hook(rec)``
         (rec is the WAL record dict: op/key/rv and obj for puts). Fires
         for every committed mutation regardless of durability backend —
-        the ReplicationHub ships exactly what the WAL records."""
+        the ReplicationHub ships exactly what the WAL records. ``batch``
+        (``batch(recs)``) is the group-commit form: a flushed window
+        ships once through it instead of once per record."""
         self._repl_hook = hook
+        self._repl_batch = batch
+
+    # ------------------------------------------------------- group commit
+
+    def commit_durable(self, rv: int | None = None):
+        """Awaitable durability barrier for the write-serving path: the
+        open commit window's future, or None when every committed
+        mutation is already synced (group commit off, sync-context
+        writes, or the window already flushed — a failed flush raised at
+        its triggering writer). The future resolves with the window's
+        HIGH RV after the shared WAL append + sync, so every writer of a
+        window can park its semi-sync standby wait on the same RV (one
+        ack releases the whole window); a failed sync resolves it with
+        the typed error instead — fail every writer, commit none.
+
+        Callers reach this in the same event-loop step as their mutation
+        (the store is loop-owned), so the open window is always the one
+        their record joined.
+
+        Idle fast path: when the loop has no other ready work, nothing
+        can join this window before its scheduled flush — flush
+        synchronously NOW and skip the loop round trip, so a lone writer
+        pays exactly the serial path's latency (the linger-must-not-tax-
+        the-idle-case guarantee). Busy loops keep the deferred flush and
+        the batching it buys."""
+        w = self._gc_window
+        if w is None or not w.recs:
+            return None
+        if w.handle is None:  # call_soon mode (no timed linger)
+            try:
+                ready = len(asyncio.get_running_loop()._ready)
+            except (RuntimeError, AttributeError):
+                ready = 2  # non-CPython loop: keep the deferred flush
+            if ready <= 1:
+                # the only pending callback is this window's own flush
+                self._gc_flush(w)
+                if w.fut.cancelled() or w.fut.exception() is not None:
+                    return w.fut  # the awaiter surfaces the typed failure
+                return None  # already durable: no wait needed
+        return w.fut
+
+    def _gc_sink(self) -> bool:
+        """True when mutations commit through group-commit windows (the
+        feature is on and there is a sink — WAL or replication hook —
+        to batch for)."""
+        return self._gc_enabled and (
+            self._engine is not None or self._wal is not None
+            or self._repl_hook is not None)
+
+    def _gc_open(self, loop) -> _CommitWindow:
+        w = _CommitWindow(loop.create_future())
+        # reconcilers and other in-process writers never await the
+        # window: retrieve the exception eagerly so a failed sync with no
+        # HTTP writer parked on it cannot log "never retrieved"
+        w.fut.add_done_callback(lambda f: f.cancelled() or f.exception())
+        self._gc_window = w
+        if self._gc_linger_s > 0:
+            w.handle = loop.call_later(self._gc_linger_s,
+                                       self._gc_flush, w)
+        else:
+            # no timed linger: the window closes at the next loop pass —
+            # everything already runnable this pass joins it, and a lone
+            # writer pays one loop iteration, not a timer tick
+            loop.call_soon(self._gc_flush, w)
+        return w
+
+    def _gc_barrier(self) -> None:
+        """Flush any open commit window NOW — out-of-band WAL records
+        (epoch stamps, snapshot compaction, close) must not overtake
+        buffered mutations in the log."""
+        w = self._gc_window
+        if w is not None:
+            self._gc_flush(w)
+
+    def _gc_flush(self, w: _CommitWindow) -> None:
+        """Close one commit window: ONE buffered WAL append + ONE sync
+        for every record in it, then ship the replication batch, resolve
+        the writers, and deliver the coalesced watch fan-out. A sync
+        failure fails every writer with a typed 503 and commits NONE of
+        the window's records (the serial path's failure contract, window
+        wide)."""
+        if w.flushed:
+            return  # a size-bound split already flushed it under the timer
+        w.flushed = True
+        if self._gc_window is w:
+            self._gc_window = None
+        if w.handle is not None:
+            w.handle.cancel()
+        recs = w.recs
+        if not recs:
+            if not w.fut.done():
+                w.fut.set_result(0)
+            return
+        try:
+            _inject("store.commit_window")
+            if self._engine is not None:
+                self._append_engine_batch(recs)
+            elif self._wal is not None and self._wal.fh is not None:
+                t0 = time.perf_counter()
+                self._wal.fh.write("".join(
+                    json.dumps(rec, separators=(",", ":")) + "\n"
+                    for rec in recs))
+                self._wal_fh_sync(t0)
+                self._wal.mutations_since_snapshot += len(recs)
+        except BaseException as e:  # noqa: BLE001 — becomes every writer's 5xx
+            err = e if isinstance(e, UnavailableError) else UnavailableError(
+                f"commit window sync failed ({len(recs)} writes "
+                f"uncommitted): {e}")
+            err.__cause__ = None if e is err else e
+            log.error("commit window FAILED: %s", err.message)
+            if not w.fut.done():
+                w.fut.set_exception(err)
+            # deliver what was emitted (in-memory state advanced exactly
+            # as a serial post-emit failure leaves it); nothing ships
+            self._flush_events()
+            return
+        self._gc_windows_total.inc()
+        self._gc_window_size.observe(len(recs))
+        # replication ships AFTER the local sync: a window that dies
+        # pre-sync was never acked anywhere — one batch, one queue push
+        # per subscriber
+        if self._repl_batch is not None:
+            self._repl_batch(recs)
+        elif self._repl_hook is not None:
+            for rec in recs:
+                self._repl_hook(rec)
+        if not w.fut.done():
+            w.fut.set_result(w.high_rv)
+        # one fan-out flush per window (not per mutation)
+        self._flush_events()
+        if self._engine is not None:
+            if self._engine_mutations >= self._engine_snapshot_every:
+                self.snapshot()
+        elif (self._wal is not None and self._wal.fh is not None
+                and self._wal.mutations_since_snapshot
+                >= self._wal.snapshot_every):
+            self.snapshot()
+
+    def _wal_fh_sync(self, t0: float) -> None:
+        """Apply the KCP_WAL_SYNC policy to the JSON-lines WAL after an
+        append (metered): ``flush`` pushes python's buffer to the OS,
+        ``fsync`` additionally forces the platters, ``off`` leaves both
+        to chance."""
+        if self._wal_sync == "off":
+            return
+        fh = self._wal.fh
+        fh.flush()
+        if self._wal_sync == "fsync":
+            os.fsync(fh.fileno())
+        self._wal_sync_total.inc()
+        self._wal_sync_seconds.observe(time.perf_counter() - t0)
+
+    def _append_engine_batch(self, recs: list[dict]) -> None:
+        """One native multi-record append (ws_batch_begin/commit): the
+        whole window's records buffer into one write() and at most one
+        fsync, per the KCP_WAL_SYNC policy."""
+        t0 = time.perf_counter()
+        ops = []
+        for rec in recs:
+            key = _wal_key(tuple(rec["key"]))
+            if rec["op"] == "put":
+                ops.append((key, json.dumps(
+                    rec["obj"], separators=(",", ":")).encode("utf-8"),
+                    rec["rv"]))
+            else:
+                ops.append((key, None, rec["rv"]))
+        self._engine.append_batch(ops, fsync=self._wal_sync == "fsync")
+        if self._wal_sync != "off":
+            self._wal_sync_total.inc()
+            self._wal_sync_seconds.observe(time.perf_counter() - t0)
+        self._engine_mutations += len(recs)
 
     def _log_wal(self, rec: dict) -> None:
-        # replication rides the WAL record stream: the hook sees every
-        # committed record (in-memory stores included — they still call
-        # _log_wal, they just have nowhere durable to put it)
+        if self._gc_sink():
+            # group commit: join (or open) the commit window — the
+            # record's durable append, replication ship, and fan-out
+            # flush all happen at the window flush. Only under a running
+            # loop: sync-context callers have nothing to drive the flush.
+            w = self._gc_window
+            if w is None:
+                try:
+                    loop = asyncio.get_running_loop()
+                except RuntimeError:
+                    loop = None
+                if loop is not None:
+                    w = self._gc_open(loop)
+            if w is not None:
+                w.recs.append(rec)
+                rv = int(rec.get("rv", 0) or 0)
+                if rv > w.high_rv:
+                    w.high_rv = rv
+                if (len(w.recs) >= self._gc_max
+                        or should_drop("store.commit_window")):
+                    # row bound reached (or an injected split drill):
+                    # flush now — the failure, if any, surfaces on the
+                    # shared future, which this writer is about to await
+                    self._gc_flush(w)
+                return
+        # serial path: group commit off, or no loop to drive a window
+        # (replication rides the WAL record stream: the hook sees every
+        # committed record — in-memory stores included)
         if self._repl_hook is not None:
             self._repl_hook(rec)
         if self._engine is not None:
             key = _wal_key(tuple(rec["key"]))
+            t0 = time.perf_counter()
             if rec["op"] == "put":
                 self._engine.put(
                     key,
@@ -1608,14 +1940,21 @@ class LogicalStore:
                 )
             else:
                 self._engine.delete(key, rec["rv"])
+            if self._wal_sync == "fsync":
+                # per-record durability: the serial A/B reference whose
+                # cost the commit window exists to amortize
+                self._engine.flush()
+                self._wal_sync_total.inc()
+                self._wal_sync_seconds.observe(time.perf_counter() - t0)
             self._engine_mutations += 1
             if self._engine_mutations >= self._engine_snapshot_every:
                 self.snapshot()
             return
         if self._wal is None or self._wal.fh is None:
             return
+        t0 = time.perf_counter()
         self._wal.fh.write(json.dumps(rec, separators=(",", ":")) + "\n")
-        self._wal.fh.flush()
+        self._wal_fh_sync(t0)
         self._wal.mutations_since_snapshot += 1
         if self._wal.mutations_since_snapshot >= self._wal.snapshot_every:
             self.snapshot()
@@ -1642,6 +1981,7 @@ class LogicalStore:
             raise InvalidError(
                 f"epoch {epoch} < current {self.epoch}: epochs never rewind")
         self.epoch = epoch
+        self._gc_barrier()  # the epoch record must not overtake a window
         if self._engine is not None:
             self._engine.set_epoch(epoch)
         elif self._wal is not None and self._wal.fh is not None:
@@ -1730,6 +2070,7 @@ class LogicalStore:
         Open watches close — their consumers re-list, exactly as after a
         410 — and the caller streams snapshot objects in via
         :meth:`load_snapshot_object` + :meth:`finish_resync`."""
+        self._gc_barrier()
         self._flush_events()
         for w in list(self._watches):
             w.close()
@@ -1819,6 +2160,7 @@ class LogicalStore:
 
     def snapshot(self) -> None:
         """Write a snapshot and truncate the WAL (etcd compaction analog)."""
+        self._gc_barrier()  # compaction must not strand buffered records
         if self._engine is not None:
             self._engine.snapshot_stream(
                 (_wal_key(k), json.dumps(v, separators=(",", ":")).encode("utf-8"))
@@ -1848,6 +2190,7 @@ class LogicalStore:
         self._wal.mutations_since_snapshot = 0
 
     def close(self) -> None:
+        self._gc_barrier()  # an open window's records reach the WAL first
         self._flush_events()
         for w in list(self._watches):
             w.close()

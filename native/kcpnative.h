@@ -44,6 +44,15 @@ uint64_t ws_rv(void* h);
 uint64_t ws_count(void* h);
 int ws_flush(void* h);     // fsync now
 
+// Multi-record group-commit append: between begin and commit, ws_put /
+// ws_del frame into an in-memory batch instead of the fd; commit writes
+// the whole batch as ONE write() and applies the sync policy once
+// (do_fsync != 0 forces fsync; otherwise sync_every batching applies).
+// Abort drops the buffered records (a failed window commits none).
+int ws_batch_begin(void* h);
+int ws_batch_commit(void* h, int do_fsync);
+int ws_batch_abort(void* h);
+
 // Replication epoch: persisted as an OP_EPOCH WAL record (and re-stamped
 // into every snapshot) so a fence/promotion survives restart. ws_set_rv
 // advances the RV watermark without a mutation record (snapshot resync).

@@ -55,6 +55,12 @@ struct WalStore {
   uint64_t epoch = 0;
   bool index_enabled = true;
   std::map<std::string, std::string> index;  // ordered: prefix scans
+  // multi-record append in progress (ws_batch_begin/commit): ws_put/
+  // ws_del frame into batch_buf instead of the fd, and the commit is
+  // ONE write() + at most one fsync for the whole group-commit window
+  bool batch_active = false;
+  std::string batch_buf;
+  int batch_records = 0;
   // streaming snapshot in progress (ws_snapshot_begin/add/commit)
   int snap_fd = -1;
   std::string snap_buf;
@@ -73,6 +79,7 @@ struct Scan {
 };
 
 void abort_snapshot(WalStore* s);  // defined with the snapshot helpers below
+bool write_all(int fd, const std::string& buf);  // ditto
 
 void put_u32(std::string* out, uint32_t v) { out->append(reinterpret_cast<char*>(&v), 4); }
 void put_u64(std::string* out, uint64_t v) { out->append(reinterpret_cast<char*>(&v), 8); }
@@ -96,6 +103,13 @@ bool append_record(WalStore* s, const std::string& payload) {
   put_u32(&rec, uint32_t(payload.size()));
   put_u32(&rec, crc32(reinterpret_cast<const uint8_t*>(payload.data()), payload.size()));
   rec += payload;
+  if (s->batch_active) {
+    // group commit: buffer the framed record; ws_batch_commit writes
+    // the whole window in one syscall and applies the sync policy once
+    s->batch_buf += rec;
+    ++s->batch_records;
+    return true;
+  }
   const char* p = rec.data();
   size_t left = rec.size();
   while (left) {
@@ -245,6 +259,53 @@ int ws_get(void* h, const uint8_t* key, uint32_t klen, const uint8_t** val, uint
 
 uint64_t ws_rv(void* h) { return static_cast<WalStore*>(h)->rv; }
 uint64_t ws_count(void* h) { return static_cast<WalStore*>(h)->index.size(); }
+
+int ws_batch_begin(void* h) {
+  auto* s = static_cast<WalStore*>(h);
+  if (s->batch_active) return -1;  // nested batches are a caller bug
+  s->batch_active = true;
+  s->batch_buf.clear();
+  s->batch_records = 0;
+  return 0;
+}
+
+int ws_batch_commit(void* h, int do_fsync) {
+  auto* s = static_cast<WalStore*>(h);
+  if (!s->batch_active) return -1;
+  s->batch_active = false;
+  int n = s->batch_records;
+  s->batch_records = 0;
+  std::string buf;
+  buf.swap(s->batch_buf);
+  if (buf.empty()) return 0;
+  if (!write_all(s->fd, buf)) {
+    s->fail("write");
+    return -1;
+  }
+  if (do_fsync) {
+    if (fsync(s->fd) != 0) {
+      s->fail("fsync");
+      return -1;
+    }
+    s->unsynced = 0;
+  } else if (s->sync_every > 0 && (s->unsynced += n) >= s->sync_every) {
+    // KCP_WAL_SYNC=flush keeps the engine's legacy amortized fsync
+    if (fsync(s->fd) != 0) {
+      s->fail("fsync");
+      return -1;
+    }
+    s->unsynced = 0;
+  }
+  return 0;
+}
+
+int ws_batch_abort(void* h) {
+  auto* s = static_cast<WalStore*>(h);
+  s->batch_active = false;
+  s->batch_buf.clear();
+  s->batch_records = 0;
+  return 0;
+}
 
 int ws_flush(void* h) {
   auto* s = static_cast<WalStore*>(h);

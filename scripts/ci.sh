@@ -250,6 +250,34 @@ print("replica smoke ok: %sx read capacity @2 | lag p99 %sms | kill: %d acked"
          kill["promote_ms"], kill["epoch"]))
 '
 
+echo "== writes: group-commit A/B smoke (write-path speedup floor, state equality, kill-mid-window drill)"
+# serial vs grouped under KCP_WAL_SYNC=fsync: the write-path component
+# (store commit + WAL sync, the thing the commit window batches) must
+# hold >=2x at 64 concurrent writers on a loaded CI host (the committed
+# BENCH_r09_writes.json gate is 3x), grouped/serial state + RV sequences
+# must match, and the kill-mid-window drill must lose zero acked writes
+# with commit windows + batched standby acks actually moving.
+wr_line=$(KCP_BENCH_WRITES_SECONDS=0.6 KCP_BENCH_WRITES_CONC=1,64 \
+    KCP_BENCH_WRITES_EQ_OPS=150 KCP_BENCH_WRITES_STORE_OPS=120 \
+    python bench.py --writes | tail -1)
+printf '%s\n' "$wr_line" | python -c '
+import json, sys
+r = json.loads(sys.stdin.readline())
+wb = r["writes_bench"]
+drill = wb["kill_drill"]
+assert r["value"] >= 2.0, "write-path speedup %sx < 2x CI floor at 64 writers" % r["value"]
+assert wb["state_equal"], "grouped vs serial final state diverged"
+assert wb["rv_sequence_equal"], "grouped vs serial RV sequences diverged"
+assert drill["ok"], "kill-mid-window drill failed: %s" % drill
+assert drill["lost_after_kill"] == 0, drill
+assert drill["commit_windows"] > 0 and drill["acks_batched"] > 0, drill
+print("writes smoke ok: %sx write-path @64 (http end-to-end %sx) | p99@1 %s->%sms"
+      " | state equal | drill: %d acked / 0 lost, %d windows, %d batched acks"
+      % (r["value"], wb["end_to_end_http"]["speedup_at_top"],
+         wb["p99_1_writer_ms"]["serial"], wb["p99_1_writer_ms"]["grouped"],
+         drill["acked_writes"], drill["commit_windows"], drill["acks_batched"]))
+'
+
 echo "== watchers: 1k-stream watcher-scale smoke (bounded RSS, delivery floor, flush A/B, evict drill)"
 # reduced-scale --watchers lane: the server runs in its own child process
 # (fd budget), 1k live streams at 10k objects. Floors: every stream
