@@ -2244,6 +2244,229 @@ def smartclient_bench() -> int:
     return 0
 
 
+def elastic_bench() -> int:
+    """Elastic scale-out A/B (``--elastic``): write capacity on an
+    N-shard fleet, then the fleet DOUBLES live — new shards join the
+    ring, every moving cluster's WAL streams to its new owner behind a
+    fence, ownership flips atomically per cluster — and capacity is
+    re-measured on 2N shards. One JSON line; ``value`` is the
+    post-scale-out capacity speedup (target >= 1.6x for a doubling:
+    migration cannot conjure capacity beyond the hardware, but it must
+    deliver most of it).
+
+    Capacity is honest on few-core CI hosts (the --sharded discipline):
+    each shard's ring partition is driven DIRECT (smart client, no
+    router hop) alone in its own time slice and the rates sum — shards
+    share nothing on the direct write path, so the sum is what N hosts
+    serve. The during-move lane rides along: writer threads (half
+    smart, half routed) run THROUGH both migrations with the production
+    retry discipline, and the bench reports their p99, the fence-window
+    503s, the migrated record count, and — the point — zero acked
+    writes lost across the move."""
+    from kcp_tpu.client.smart import SmartRestClient
+    from kcp_tpu.server.rest import MultiClusterRestClient, RestClient
+    from kcp_tpu.server.server import Config
+    from kcp_tpu.server.threaded import ServerThread
+    from kcp_tpu.sharding import ShardRing, migrate, owner_name
+    from kcp_tpu.utils import errors as kerrors
+    from kcp_tpu.utils.trace import REGISTRY
+
+    n_before = int(os.environ.get("KCP_BENCH_ELASTIC_SHARDS", "2"))
+    n_after = 2 * n_before
+    seconds = float(os.environ.get("KCP_BENCH_ELASTIC_SECONDS", "2.0"))
+    # 16 clusters: enough keyspace that HRW lands work on EVERY shard of
+    # the doubled ring (fewer leaves a shard idle and understates the
+    # honest capacity sum)
+    n_clusters = int(os.environ.get("KCP_BENCH_ELASTIC_CLUSTERS", "16"))
+    n_threads = int(os.environ.get("KCP_BENCH_ELASTIC_THREADS", "2"))
+    clusters = [f"t{i}" for i in range(n_clusters)]
+
+    def obj(cluster: str, name: str) -> dict:
+        return {"apiVersion": "v1", "kind": "ConfigMap",
+                "metadata": {"name": name, "namespace": "default",
+                             "clusterName": cluster}, "data": {}}
+
+    def pct(vals: list[float], q: float) -> float:
+        if not vals:
+            return 0.0
+        return round(float(np.percentile(np.asarray(vals), q)) * 1e3, 3)
+
+    threads: list[ServerThread] = []
+    try:
+        # ---- the starting fleet: n_before in-process shards + router
+        names0 = ",".join(f"s{i}" for i in range(n_before))
+        for i in range(n_before):
+            threads.append(ServerThread(Config(
+                durable=False, install_controllers=False, tls=False,
+                shard_name=f"s{i}", ring_names=names0,
+                ring_epoch=1)).start())
+        spec = ",".join(f"s{i}={t.address}"
+                        for i, t in enumerate(threads))
+        router = ServerThread(Config(role="router", shards=spec,
+                                     durable=False, tls=False)).start()
+        threads.append(router)
+        raddr = router.address
+
+        def slice_capacity(tag: str) -> list[dict]:
+            """Per-shard time slices over the router's CURRENT ring:
+            each shard's owned clusters driven direct, alone; summing
+            the slices is the N-host capacity claim."""
+            rc = RestClient(raddr)
+            doc = rc._request("GET", "/ring")
+            rc.close()
+            ring_names = [s["name"] for s in doc["shards"]]
+            per = []
+            for i, nm in enumerate(ring_names):
+                owned = [c for c in clusters
+                         if owner_name(ring_names, c) == nm]
+                if not owned:
+                    continue
+                sc = SmartRestClient(raddr, cluster=owned[0])
+                scoped = {c: sc.scoped(c) for c in owned}
+                for j, c in enumerate(owned):  # warm conns + ring
+                    scoped[c].create("configmaps",
+                                     obj(c, f"{tag}-warm-{i}-{j}"))
+                stop_at = time.perf_counter() + max(
+                    0.5, seconds / len(ring_names))
+                n = 0
+                t0 = time.perf_counter()
+                while time.perf_counter() < stop_at:
+                    c = owned[n % len(owned)]
+                    scoped[c].create("configmaps", obj(c, f"{tag}-{i}-{n}"))
+                    n += 1
+                wall = time.perf_counter() - t0
+                sc.close()
+                per.append({"shard": nm, "clusters": len(owned),
+                            "per_s": round(n / max(wall, 1e-9))})
+            return per
+
+        per_before = slice_capacity("cb")
+        cap_before = sum(s["per_s"] for s in per_before)
+
+        # ---- the move: writers run THROUGH the 2N doubling
+        mr0 = REGISTRY.counter("migration_records_total").value
+        mf0 = REGISTRY.counter("migration_fenced_writes_total").value
+        acked: set[tuple[str, str]] = set()
+        acked_lock = threading.Lock()
+        lats: list[list[float]] = [[] for _ in range(n_threads)]
+        retries = [0] * n_threads
+        surfaced = [0] * n_threads
+        stop = threading.Event()
+
+        def mover_writer(k: int) -> None:
+            # half smart (direct + fallback), half routed: both client
+            # shapes must survive the move with plain retry discipline
+            cls = SmartRestClient if k % 2 == 0 else RestClient
+            base = cls(raddr, cluster=clusters[0])
+            scoped = {c: base.scoped(c) for c in clusters}
+            n = 0
+            while not stop.is_set():
+                c = clusters[n % len(clusters)]
+                name = f"mv-{k}-{n}"
+                t0 = time.perf_counter()
+                deadline = t0 + 30.0
+                while True:
+                    try:
+                        scoped[c].create("configmaps", obj(c, name))
+                        with acked_lock:
+                            acked.add((c, name))
+                        break
+                    except kerrors.AlreadyExistsError:
+                        with acked_lock:
+                            acked.add((c, name))
+                        break
+                    except (kerrors.UnavailableError, kerrors.GoneError,
+                            ConnectionError, OSError):
+                        # fence-window 503s and flip-window 410s are the
+                        # mechanism, not failures; retry until the ring
+                        # settles (a stuck client would surface below)
+                        retries[k] += 1
+                        if time.perf_counter() > deadline:
+                            surfaced[k] += 1
+                            break
+                        time.sleep(0.02)
+                lats[k].append(time.perf_counter() - t0)
+                n += 1
+                time.sleep(0.005)
+            base.close()
+
+        writers = [threading.Thread(target=mover_writer, args=(k,),
+                                    daemon=True) for k in range(n_threads)]
+        for t in writers:
+            t.start()
+        time.sleep(0.3)
+        t_move0 = time.perf_counter()
+        migrated = []
+        for i in range(n_before, n_after):
+            grown = ",".join(f"s{j}" for j in range(i + 1))
+            shard = ServerThread(Config(
+                durable=False, install_controllers=False, tls=False,
+                shard_name=f"s{i}", ring_names=grown,
+                ring_epoch=1)).start()
+            threads.append(shard)
+            migrated.append(migrate.scale_out(
+                raddr, f"s{i}={shard.address}"))
+        t_move = time.perf_counter() - t_move0
+        time.sleep(0.3)
+        stop.set()
+        for t in writers:
+            t.join()
+
+        # zero lost acked writes: every ack readable through the router
+        wc = MultiClusterRestClient(raddr)
+        items, _rv = wc.list("configmaps")
+        have = {(o["metadata"].get("clusterName", ""),
+                 o["metadata"]["name"]) for o in items}
+        rc = RestClient(raddr)
+        epoch_after = rc._request("GET", "/ring")["epoch"]
+        rc.close()
+        wc.close()
+        missing = acked - have
+        move_lat = [x for la in lats for x in la]
+
+        per_after = slice_capacity("ca")
+        cap_after = sum(s["per_s"] for s in per_after)
+        speedup = round(cap_after / max(cap_before, 1e-9), 2)
+    finally:
+        for t in reversed(threads):
+            t.stop()
+
+    out = {
+        "metric": "elastic_scaleout_capacity_speedup",
+        "value": speedup,
+        "unit": "x",
+        "elastic_bench": {
+            "host_cpus": os.cpu_count(),
+            "shards_before": n_before,
+            "shards_after": n_after,
+            "clusters": n_clusters,
+            "seconds": seconds,
+            "capacity_before_per_s": cap_before,
+            "capacity_after_per_s": cap_after,
+            "per_shard_before": per_before,
+            "per_shard_after": per_after,
+            "during_move": {
+                "move_seconds": round(t_move, 3),
+                "acked_writes": len(acked),
+                "lost_after_move": len(missing),
+                "errors_surfaced": sum(surfaced),
+                "retries": sum(retries),
+                "write_p50_ms": pct(move_lat, 50),
+                "write_p99_ms": pct(move_lat, 99),
+                "migrated_clusters": sum(
+                    len(m["migrated"]) for m in migrated),
+                "migration_records": int(REGISTRY.counter(
+                    "migration_records_total").value - mr0),
+                "fenced_write_503s": int(REGISTRY.counter(
+                    "migration_fenced_writes_total").value - mf0),
+                "ring_epoch_after": epoch_after,
+            },
+        },
+    }
+    emit(out)
+    return 0
+
+
 def replica_bench() -> int:
     """HA replication A/B (``--replica``): read capacity at 0/1/2 read
     replicas, replica visibility lag, byte-equality at the same RV, and
@@ -3958,7 +4181,8 @@ if __name__ == "__main__":
     if ("--store" in args or "--admission" in args or "--encode" in args
             or "--sharded" in args or "--replica" in args
             or "--watchers" in args or "--trace" in args
-            or "--smartclient" in args or "--writes" in args):
+            or "--smartclient" in args or "--writes" in args
+            or "--elastic" in args):
         # pure-host microbenches: pin CPU (never touch the tunnel)
         # and run in-process — no watchdog child needed
         try:
@@ -3974,6 +4198,7 @@ if __name__ == "__main__":
                  else watchers_bench() if "--watchers" in args
                  else trace_bench() if "--trace" in args
                  else smartclient_bench() if "--smartclient" in args
+                 else elastic_bench() if "--elastic" in args
                  else writes_bench() if "--writes" in args
                  else encode_bench())
     if "--probe" in args:

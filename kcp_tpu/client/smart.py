@@ -25,6 +25,15 @@ Correctness never depends on ring freshness:
   smart mode: the client behaves exactly like a plain
   :class:`~kcp_tpu.server.rest.RestClient`.
 
+The ring document also carries the router's pending-migration
+``overrides`` (cluster -> shard name): while a cluster's WAL is moving
+to a new owner, the override pins it to its OLD shard, so smart clients
+keep landing direct hits mid-migration and flip atomically with the
+fleet the moment the router drops the pin. ``KCP_RING_REFRESH_S=N``
+(default off) adds a background periodic re-fetch through the same
+epoch-verified path — useful on fleets that scale out while a client
+sits idle (no traffic means no 410 to trigger the reactive refresh).
+
 Responses on the direct path are byte-identical to routed responses
 (modulo hop-specific headers) — the differential fuzz in
 tests/test_smartclient.py and the sha256 cross-check in
@@ -35,6 +44,7 @@ from __future__ import annotations
 
 import http.client
 import os
+import threading
 import time
 from urllib.parse import unquote, urlsplit
 
@@ -73,6 +83,17 @@ def smart_enabled() -> bool:
         "1", "true", "on")
 
 
+def ring_refresh_interval() -> float:
+    """``KCP_RING_REFRESH_S``: background periodic ring re-fetch cadence
+    in seconds; 0 (the default) disables the refresher — the reactive
+    410/503-triggered refresh is the only freshness mechanism then."""
+    try:
+        return max(0.0, float(os.environ.get("KCP_RING_REFRESH_S", "0")
+                              or 0.0))
+    except ValueError:
+        return 0.0
+
+
 class _RingState:
     """Ring + per-shard pools, SHARED across every ``scoped()`` clone
     of one smart client (like the discovery cache and breaker)."""
@@ -86,6 +107,7 @@ class _RingState:
         self.parked_until = 0.0     # /ring unavailable: plain-client mode
         self.cap = pool_cap if pool_cap is not None else int(
             os.environ.get("KCP_ROUTER_POOL", "8"))
+        self.stop = threading.Event()   # ends the background refresher
 
 
 class SmartRestClient(RestClient):
@@ -104,6 +126,23 @@ class SmartRestClient(RestClient):
         super().__init__(base_url, cluster, scheme, token=token,
                          ca_data=ca_data, ca_file=ca_file)
         self._ring_state = _RingState(pool_cap)
+        interval = ring_refresh_interval()
+        if interval > 0:
+            # one refresher per client FAMILY (scoped() clones share the
+            # ring state, so they share this thread too); it dies with
+            # close() or the process (daemon)
+            t = threading.Thread(
+                target=self._refresh_loop, args=(interval,),
+                name="smart-ring-refresh", daemon=True)
+            t.start()
+
+    def _refresh_loop(self, interval: float) -> None:
+        st = self._ring_state
+        while not st.stop.wait(interval):
+            # forced: the cadence itself is the rate limit, and an idle
+            # client never generates the 410 that would trigger the
+            # reactive path; parked base URLs still short-circuit inside
+            self._refresh_ring(force=True)
 
     # -------------------------------------------------------------- ring
 
@@ -130,7 +169,12 @@ class SmartRestClient(RestClient):
             shards = [Shard(s["name"], s["url"].rstrip("/"),
                             tuple(s.get("replicas", ())))
                       for s in body.get("shards", [])]
-            ring = ShardRing(shards) if shards else None
+            # pending-migration pins ride the ring doc: owner_index()
+            # keeps resolving a migrating cluster to its OLD shard until
+            # the router drops the pin (the atomic per-cluster flip)
+            overrides = {str(c): str(n) for c, n in
+                         (body.get("overrides") or {}).items()}
+            ring = ShardRing(shards, overrides) if shards else None
         except (errors.ApiError, ConnectionError, OSError, ValueError,
                 KeyError, TypeError, http.client.HTTPException):
             ring = None
@@ -278,6 +322,7 @@ class SmartRestClient(RestClient):
     def close(self) -> None:
         super().close()
         st = self._ring_state
+        st.stop.set()
         with st.lock:
             pools, st.pools = list(st.pools.values()), {}
             st.ring = None

@@ -83,6 +83,13 @@ Injection points wired in this codebase:
                                  error = the scenario run aborts — the
                                  harness's own failure path is drilled
                                  like everything else)
+    migrate.cutover              sharding/migrate.py between migration
+                                 finish and the ring flip — the worst
+                                 instant to die (target loaded, ring not
+                                 flipped; error = the migration aborts
+                                 and the source fence rolls back so the
+                                 cluster keeps serving from its old
+                                 owner, latency = a slow cutover)
 
 Sites call the module-level helpers, which are near-free no-ops when no
 injector is active (one global read).
@@ -133,6 +140,7 @@ POINTS = frozenset({
     "repl.promote",
     "server.drain",
     "scenario.phase",
+    "migrate.cutover",
 })
 
 

@@ -208,10 +208,16 @@ class ReplicationHub:
     # ------------------------------------------------------------- feed
 
     async def serve_feed(self, stream, since_rv: int, sub_epoch: int,
-                         role: str) -> None:
+                         role: str, cluster: str | None = None) -> None:
         """Produce one follower's feed onto a StreamResponse: header,
         tail-or-snapshot catchup, then live records until the connection
-        dies or a ``repl.ship`` fault kills it."""
+        dies or a ``repl.ship`` fault kills it.
+
+        ``cluster`` selects the migration transport: a snapshot of that
+        one cluster's objects, BARRIER, done — no live phase. The caller
+        (sharding/migrate.py) fences the cluster on this store FIRST, so
+        the filtered snapshot IS the cluster's final state and the
+        BARRIER rv bounds every RV it ever minted for it."""
         delay = maybe_fail("repl.ship")
         if delay:
             await asyncio.sleep(delay)
@@ -233,13 +239,16 @@ class ReplicationHub:
             # header/tail/snapshot cover everything at or before it
             rv_now = self.store.resource_version
             oldest = self._records[0][0] if self._records else None
-            need_snapshot = since_rv < rv_now and (
-                oldest is None or oldest > since_rv + 1)
+            need_snapshot = cluster is not None or (since_rv < rv_now and (
+                oldest is None or oldest > since_rv + 1))
             header = json.dumps({
                 "type": "HEADER", "epoch": self.store.epoch, "rv": rv_now,
                 "sub": sub.sid, "snapshot": need_snapshot,
             }).encode() + b"\n"
-            if need_snapshot:
+            if cluster is not None:
+                snapshot = [(k, o) for k, o in self.store._objects.items()
+                            if k[1] == cluster]
+            elif need_snapshot:
                 snapshot = list(self.store._objects.items())
             else:
                 snapshot = []
@@ -259,6 +268,10 @@ class ReplicationHub:
                     {"type": "BARRIER", "rv": rv_now}).encode() + b"\n")
                 await stream.send_spans(batch)
                 self._shipped.inc(len(snapshot))
+                if cluster is not None:
+                    # migration transport ends at the barrier: the
+                    # cluster is fenced, nothing more can follow
+                    return
             elif tail:
                 # the catchup tail is encode-once bytes (each record was
                 # serialized exactly once at commit): the raw-spans send

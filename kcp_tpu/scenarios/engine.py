@@ -54,7 +54,9 @@ TRACKED_COUNTERS = ("repl_promotions_total", "repl_rehome_total",
                     "smart_client_fallback_total",
                     "smart_client_ring_refreshes_total",
                     "store_commit_windows_total",
-                    "repl_ack_batched_total")
+                    "repl_ack_batched_total",
+                    "migration_records_total",
+                    "migration_fenced_writes_total")
 
 
 def pctile(vals: list[float], q: float) -> float:
@@ -86,6 +88,13 @@ async def _run_action(action: str, topology, observers, loop) -> None:
         # NEW address, republish /ring — smart clients must absorb the
         # move with one-shot fallbacks, routed clients with retries
         await loop.run_in_executor(None, topology.move_shard)
+    elif action == "scale_out":
+        # elastic capacity: grow the fleet by one shard LIVE — the
+        # grown ring publishes with movers pinned, each pinned
+        # cluster's WAL streams to the new owner, ownership flips
+        # atomically per cluster; writers eat fence-503 retries and
+        # watchers ride typed 410 relists, never a lost acked write
+        await loop.run_in_executor(None, topology.scale_out)
     elif action == "drop_watchers":
         # the reconnect storm: EVERY stream severed in the same instant,
         # every observer resumes from its last_rv at once

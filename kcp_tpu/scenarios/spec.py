@@ -58,7 +58,9 @@ class Phase:
     action: str = ""        # engine action: rolling_restart_drain |
     # rolling_restart_kill | kill_primary | drop_watchers | flood |
     # move_shard (drain a shard, restart on a NEW address, republish
-    # /ring — the ring-change-under-load lever)
+    # /ring — the ring-change-under-load lever) | scale_out (grow the
+    # fleet by one shard live and migrate every moving cluster's WAL
+    # onto it — the elastic-capacity lever)
     settle_s: float = 0.3   # quiesce wait after the phase's work completes
 
 

@@ -314,6 +314,34 @@ class RouterFleet:
             i = ShardRing.from_spec(spec).owner_index("t0")
         move_shard(self.shards, i, self.router.address)
 
+    def scale_out(self) -> ServerThread:
+        """The elastic-capacity lever: grow the fleet by ONE shard while
+        the workload runs. Starts ``s<n>`` (durable, booted with the
+        grown ring identity so epoch-stamped direct requests verify),
+        then drives :func:`kcp_tpu.sharding.migrate.scale_out` against
+        the router — the grown ring publishes with every moving cluster
+        pinned to its old owner, each pinned cluster's WAL streams to
+        the new shard, and ownership flips atomically per cluster.
+        Raises (scenario fails) if any migration step refuses."""
+        from ..sharding import migrate
+
+        i = len(self.shards)
+        with _env_patch(self.env):
+            names = ",".join(
+                [t.server.config.shard_name or f"s{j}"
+                 for j, t in enumerate(self.shards)] + [f"s{i}"])
+            kw: dict = dict(durable=self.durable,
+                            install_controllers=False, tls=False,
+                            shard_name=f"s{i}", ring_names=names,
+                            ring_epoch=1)
+            if self.durable:
+                kw["root_dir"] = os.path.join(self.root_dir, f"shard{i}")
+            new = ServerThread(Config(**kw)).start()
+        self.shards.append(new)
+        self.n += 1
+        migrate.scale_out(self.router.address, f"s{i}={new.address}")
+        return new
+
     def stop(self) -> None:
         if self.router is not None:
             self.router.stop()

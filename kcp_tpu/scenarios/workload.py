@@ -354,7 +354,19 @@ class StreamObserver:
 
     def _relist(self) -> None:
         items, rv = self.client.list(RESOURCE, NAMESPACE)
+        now = time.monotonic()
         self.cache = {o["metadata"]["name"]: o for o in items}
+        # a relist is delivery, not amnesia: an informer synthesizes
+        # events from the list contents, so every listed (name, rv)
+        # counts as observed. Acked states OVERWRITTEN before the relist
+        # stay unobserved — the intermediate-event gap a kill-without-
+        # drain costs — and across a shard migration the target's
+        # re-minted rvs are only ever coverable here (the 410→relist is
+        # the designed hand-off, not a loss).
+        for o in items:
+            self.stats.events.setdefault(
+                (o["metadata"]["name"],
+                 int(o["metadata"].get("resourceVersion", "0"))), now)
         self.stats.last_rv = max(self.stats.last_rv, rv)
         self.stats.relists += 1
         # fd hygiene at watcher scale: a 10k-observer fleet must not
@@ -404,10 +416,11 @@ class StreamObserver:
             if self._stopping:
                 return
             if isinstance(err, errors.GoneError):
-                # the server cannot replay the gap: events between our
-                # last_rv and the relist are UNRECOVERABLE — exactly what
-                # kill-without-drain costs (counted as lost by the
-                # coverage check, since their rvs were never observed)
+                # the server cannot replay the gap: INTERMEDIATE states
+                # between our last_rv and the relist are UNRECOVERABLE —
+                # exactly what kill-without-drain costs (still counted
+                # as lost by the coverage check: an overwritten rv is in
+                # nobody's relist). Current states land via the relist.
                 self.stats.gone_410 += 1
                 try:
                     await loop.run_in_executor(None, self._relist)

@@ -186,6 +186,11 @@ class RestHandler:
         self.shard_name = ""
         self.ring_names: tuple[str, ...] = ()
         self.ring_epoch = 0
+        # per-cluster pending-migration overlay (cluster -> owning shard
+        # NAME): while a cluster migrates, the router pins it to its old
+        # owner and fans the pinned map out here (POST /ring) so direct
+        # verification agrees with routing mid-move.
+        self.ring_overrides: dict[str, str] = {}
 
     async def _st(self, fn, *args, **kwargs):
         """Run a store call; offloaded to the I/O pool for remote stores."""
@@ -309,7 +314,8 @@ class RestHandler:
             # the client re-fetches /ring and takes one router hop.
             from ..sharding.ring import owner_name
 
-            owner = owner_name(self.ring_names, cluster)
+            owner = self.ring_overrides.get(cluster) or owner_name(
+                self.ring_names, cluster)
             if owner != self.shard_name:
                 resp = _error_response(errors.GoneError(
                     f"ring mismatch: cluster {cluster!r} is owned by "
@@ -404,6 +410,10 @@ class RestHandler:
             })
         if head == "replication":
             return await self._replication(req, segs[1:])
+        if head == "migration":
+            return await self._migration(req, segs[1:])
+        if head == "ring" and req.method == "POST" and self.shard_name:
+            return await self._ring_install(req)
         if head == "api":
             return await self._route_group(req, cluster, group="", segs=segs[1:])
         if head == "apis":
@@ -869,14 +879,19 @@ class RestHandler:
                 raise errors.BadRequestError(
                     f"malformed replication params: {e}") from e
             role = req.param("role", "replica")
-            if role not in ("replica", "standby"):
+            if role not in ("replica", "standby", "migration"):
                 raise errors.BadRequestError(
                     f"unknown replication role {role!r}")
+            # migration transport (sharding/migrate.py): one cluster's
+            # post-fence snapshot + BARRIER, nothing else — the same
+            # feed, filtered
+            mig_cluster = req.param("cluster") or None
             hub = self.repl_hub
 
             async def produce(stream: StreamResponse) -> None:
                 try:
-                    await hub.serve_feed(stream, since_rv, sub_epoch, role)
+                    await hub.serve_feed(stream, since_rv, sub_epoch,
+                                         role, mig_cluster)
                 except errors.ApiError as e:
                     await stream.send_json({
                         "type": "ERROR",
@@ -905,6 +920,111 @@ class RestHandler:
                 200, "OK",
                 f"epoch {self.store.epoch}"
                 + (" (fenced)" if self.store.fenced else "")))
+        return _error_response(
+            errors.NotFoundError(f"unknown path {req.path}"))
+
+    # --------------------------------------------------------- migration
+
+    async def _ring_install(self, req: Request) -> Response:
+        """Shard-side ring identity update (``POST /ring``): the router
+        fans the grown/shrunk ring (names, epoch, pending-migration
+        overrides) out to every member on each epoch bump, so direct
+        smart-client verification keeps agreeing with routing. The
+        epoch never rewinds (a late fan-out from a superseded publish
+        must not reinstate a stale ring)."""
+        if not await self._server_scope_allowed(req):
+            return self._forbidden(req, "update the shard ring")
+        body = self._body_object(req)
+        try:
+            epoch = int(body.get("epoch", 0))
+            names = tuple(str(n) for n in (body.get("names") or ()))
+            overrides = {str(c): str(n) for c, n in
+                         (body.get("overrides") or {}).items()}
+        except (TypeError, ValueError, AttributeError) as e:
+            raise errors.BadRequestError(
+                f"malformed ring document: {e}") from e
+        if not names or self.shard_name not in names:
+            raise errors.BadRequestError(
+                f"ring names {list(names)} must include this shard "
+                f"({self.shard_name!r})")
+        if epoch < self.ring_epoch:
+            raise errors.ConflictError(
+                f"ring epoch {epoch} is older than this shard's "
+                f"{self.ring_epoch}; ring epochs never rewind")
+        self.ring_names = names
+        self.ring_epoch = epoch
+        self.ring_overrides = overrides
+        return Response.of_json(_status_body(
+            200, "OK", f"ring installed: epoch {epoch}, "
+            f"{len(names)} shards, {len(overrides)} pending migrations"))
+
+    async def _migration(self, req: Request, segs: list[str]):
+        """The live-migration control surface (sharding/migrate.py):
+
+        - ``POST /migration/fence``   {cluster} on the SOURCE — refuse
+          further writes to the cluster, return its cutover RV
+        - ``POST /migration/unfence`` {cluster} — abort rollback
+        - ``POST /migration/ingest``  ndjson WAL-shaped records on the
+          TARGET — apply with fresh local RVs
+        - ``POST /migration/finish``  {cluster, source_rv} on the TARGET
+          — advance the RV counter past the source's and set the
+          cluster's resume floor
+        - ``POST /migration/purge``   {cluster} on the SOURCE — evict
+          the cluster's watches (typed 410) and drop its objects with
+          no watch events
+
+        All of it moves tenant data across trust boundaries, so every
+        verb is gated like the other server-global surfaces."""
+        if req.method != "POST":
+            return _error_response(
+                errors.BadRequestError("migration endpoints are POST-only"))
+        if not await self._server_scope_allowed(req):
+            user = (self.authenticator.user_for(req.headers)
+                    if self.authenticator else "anonymous")
+            return Response.of_json(
+                _status_body(403, "Forbidden",
+                             f'user "{user}" cannot access migration'),
+                403)
+        st = self.store
+        if not hasattr(st, "fence_cluster"):
+            return _error_response(errors.NotFoundError(
+                "no local store on this server (routers and remote-store "
+                "frontends do not hold cluster data)"))
+        if segs == ["ingest"]:
+            applied = 0
+            last_rv = None
+            for line in (req.body or b"").splitlines():
+                if not line.strip():
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError as e:
+                    raise errors.BadRequestError(
+                        f"malformed migration record: {e}") from e
+                rv = st.apply_migrated(rec)
+                if rv is not None:
+                    applied += 1
+                    last_rv = rv
+            return Response.of_json({"applied": applied, "rv": last_rv})
+        body = self._body_object(req)
+        cluster = body.get("cluster")
+        if not cluster or not isinstance(cluster, str):
+            raise errors.BadRequestError(
+                "migration request needs a cluster name")
+        if segs == ["fence"]:
+            return Response.of_json(
+                {"cluster": cluster, "cutover_rv": st.fence_cluster(cluster)})
+        if segs == ["unfence"]:
+            st.unfence_cluster(cluster)
+            return Response.of_json(_status_body(
+                200, "OK", f"cluster {cluster} unfenced"))
+        if segs == ["finish"]:
+            floor = st.finish_migration(cluster,
+                                        int(body.get("source_rv", 0)))
+            return Response.of_json({"cluster": cluster, "floor_rv": floor})
+        if segs == ["purge"]:
+            return Response.of_json(
+                {"cluster": cluster, "purged": st.purge_cluster(cluster)})
         return _error_response(
             errors.NotFoundError(f"unknown path {req.path}"))
 

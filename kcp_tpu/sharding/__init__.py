@@ -13,9 +13,9 @@ Run it: ``kcp start --role shard`` per shard (a plain server), then
 ``kcp start --role router --shards s0=http://h0:6443,s1=http://h1:6443``.
 """
 
-from .ring import Shard, ShardRing
+from .ring import Shard, ShardRing, owner_name
 from .router import RouterHandler
 from .rvmap import decode_rvmap, encode_rvmap
 
-__all__ = ["Shard", "ShardRing", "RouterHandler",
+__all__ = ["Shard", "ShardRing", "RouterHandler", "owner_name",
            "decode_rvmap", "encode_rvmap"]

@@ -56,17 +56,44 @@ def owner_name(names, cluster: str) -> str:
 
 
 class ShardRing:
-    """An ordered, deduplicated set of shards with HRW ownership."""
+    """An ordered, deduplicated set of shards with HRW ownership.
 
-    def __init__(self, shards: list[Shard]):
+    ``overrides`` is the per-cluster *pending-migration* overlay: while
+    a cluster's WAL is being streamed to its new HRW owner, the ring
+    pins it to the OLD owner by name so ownership flips atomically per
+    cluster (when the migration completes and the override is dropped),
+    never wholesale at an epoch bump. Overrides ride the ``/ring``
+    document, so routers, shards, and smart clients all resolve the
+    same owner mid-migration."""
+
+    def __init__(self, shards: list[Shard],
+                 overrides: dict[str, str] | None = None):
         if not shards:
             raise ValueError("shard ring needs at least one shard")
-        seen: set[str] = set()
+        seen_names: dict[str, str] = {}
+        seen_urls: dict[str, str] = {}
         for s in shards:
-            if s.name in seen:
-                raise ValueError(f"duplicate shard name {s.name!r}")
-            seen.add(s.name)
+            if s.name in seen_names:
+                raise ValueError(
+                    f"duplicate shard name {s.name!r} (urls {seen_names[s.name]!r}"
+                    f" and {s.url!r}): shard names are ring identities — "
+                    f"rename one entry in KCP_SHARDS/--shards")
+            if s.url in seen_urls:
+                raise ValueError(
+                    f"duplicate shard url {s.url!r} (names {seen_urls[s.url]!r}"
+                    f" and {s.name!r}): two ring entries would route distinct "
+                    f"keyspaces to one server — remove or fix one entry in "
+                    f"KCP_SHARDS/--shards")
+            seen_names[s.name] = s.url
+            seen_urls[s.url] = s.name
         self.shards: tuple[Shard, ...] = tuple(shards)
+        ov = dict(overrides or {})
+        for cluster, name in ov.items():
+            if name not in seen_names:
+                raise ValueError(
+                    f"override {cluster!r} -> {name!r} names a shard "
+                    f"not in the ring ({sorted(seen_names)})")
+        self.overrides: dict[str, str] = ov
 
     def __len__(self) -> int:
         return len(self.shards)
@@ -74,9 +101,24 @@ class ShardRing:
     def __iter__(self):
         return iter(self.shards)
 
+    def index_of(self, name: str) -> int:
+        for i, s in enumerate(self.shards):
+            if s.name == name:
+                return i
+        raise ValueError(f"no shard named {name!r} in the ring")
+
     def owner_index(self, cluster: str) -> int:
         """Index of the shard owning ``cluster`` (ties broken by name so
-        the choice is total even for colliding 64-bit weights)."""
+        the choice is total even for colliding 64-bit weights); a
+        pending-migration override pins the cluster to its old owner."""
+        pinned = self.overrides.get(cluster)
+        if pinned is not None:
+            return self.index_of(pinned)
+        return self.hrw_index(cluster)
+
+    def hrw_index(self, cluster: str) -> int:
+        """Pure HRW owner index, ignoring overrides — the *target* of a
+        pending migration (``owner_index`` is where traffic goes NOW)."""
         best = 0
         best_key = (_weight(self.shards[0].name, cluster), self.shards[0].name)
         for i in range(1, len(self.shards)):
@@ -87,6 +129,39 @@ class ShardRing:
 
     def owner(self, cluster: str) -> Shard:
         return self.shards[self.owner_index(cluster)]
+
+    def with_shard_added(self, shard: Shard,
+                         pin_clusters: list[str] | None = None) -> "ShardRing":
+        """A new ring with ``shard`` appended; ``pin_clusters`` are the
+        existing clusters whose HRW owner would change — each is pinned
+        (override) to its CURRENT owner so nothing moves until its
+        migration completes."""
+        ov = dict(self.overrides)
+        for cluster in pin_clusters or ():
+            ov.setdefault(cluster, self.shards[self.owner_index(cluster)].name)
+        return ShardRing(list(self.shards) + [shard], ov)
+
+    def with_shard_removed(self, name: str) -> "ShardRing":
+        """A new ring without shard ``name``; refuses while any override
+        still pins a cluster to it (that cluster's data lives there)."""
+        pinned = sorted(c for c, n in self.overrides.items() if n == name)
+        if pinned:
+            raise ValueError(
+                f"cannot remove shard {name!r}: clusters {pinned} are "
+                f"still pinned to it by pending migrations")
+        remaining = [s for s in self.shards if s.name != name]
+        if len(remaining) == len(self.shards):
+            raise ValueError(f"no shard named {name!r} in the ring")
+        return ShardRing(remaining, dict(self.overrides))
+
+    def without_override(self, cluster: str) -> "ShardRing":
+        """A new ring with ``cluster``'s pending-migration pin dropped —
+        the atomic per-cluster ownership flip."""
+        if cluster not in self.overrides:
+            raise ValueError(f"no pending migration for cluster {cluster!r}")
+        ov = dict(self.overrides)
+        del ov[cluster]
+        return ShardRing(list(self.shards), ov)
 
     @classmethod
     def from_spec(cls, spec: str, replicas: str = "") -> "ShardRing":

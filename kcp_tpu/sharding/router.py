@@ -61,7 +61,7 @@ from ..store.store import WILDCARD
 from ..utils import errors
 from ..utils.routing import resolve_write_cluster
 from ..utils.trace import REGISTRY
-from .ring import ShardRing
+from .ring import ShardRing, owner_name
 from .rvmap import decode_rvmap, encode_rvmap
 
 log = logging.getLogger(__name__)
@@ -269,6 +269,11 @@ class RouterHandler:
             "shards": [{"name": s.name, "url": s.url,
                         "replicas": list(s.replicas)}
                        for s in self.ring.shards],
+            # pending-migration overlay: clusters pinned to their OLD
+            # owner while their data streams to the new one — clients
+            # and shards resolve owners override-first, so ownership
+            # flips atomically per cluster when its pin drops
+            "overrides": dict(self.ring.overrides),
         }
 
     def set_ring(self, ring: ShardRing) -> None:
@@ -304,8 +309,35 @@ class RouterHandler:
             self._primary_fails = [0] * len(ring)
             self._last_probe = [0.0] * len(ring)
             self.ring_epoch += 1
-        log.warning("ring republished (epoch %d): %s", self.ring_epoch,
-                    [f"{s.name}={s.url}" for s in ring])
+        log.warning("ring republished (epoch %d): %s overrides=%s",
+                    self.ring_epoch,
+                    [f"{s.name}={s.url}" for s in ring], ring.overrides)
+        self._fanout_ring()
+
+    def _fanout_ring(self) -> None:
+        """Install the new ring identity (names, epoch, overrides) on
+        every member shard, best-effort in the background: a shard that
+        misses the fan-out answers spurious ring-mismatch 410s to direct
+        clients, who fall back through the router — correctness never
+        depends on delivery (shard-side epoch monotonicity discards any
+        late, superseded install)."""
+        with self._rehome_lock:
+            doc = {"epoch": self.ring_epoch,
+                   "names": [s.name for s in self.ring.shards],
+                   "overrides": dict(self.ring.overrides)}
+            pools = list(self._pools)
+        payload = json.dumps(doc).encode()
+
+        def _post(pool: ConnectionPool) -> None:
+            try:
+                with pool.client() as c:
+                    c.request_raw("POST", "/ring", payload,
+                                  {"content-type": "application/json"})
+            except Exception:
+                pass  # best-effort (see docstring)
+
+        for p in pools:
+            self._exec.submit(_post, p)
 
     # ----------------------------------------------------------- plumbing
 
@@ -408,12 +440,13 @@ class RouterHandler:
             shards[idx] = type(s)(
                 s.name, promoted.base_url,
                 tuple(u for u in s.replicas if u != promoted.base_url))
-            self.ring = ShardRing(shards)
+            self.ring = ShardRing(shards, dict(self.ring.overrides))
             self.ring_epoch += 1
         self._rehomes.inc()
         log.warning("shard %s: write routing re-homed %s -> %s "
                     "(promoted replica)", self.ring.shards[idx].name,
                     old.base_url, promoted.base_url)
+        self._fanout_ring()
         return True
 
     @staticmethod
@@ -624,13 +657,34 @@ class RouterHandler:
                             content_type="text/plain")
         if head == "ring":
             # the smart-client handshake surface: GET serves the current
-            # ring + epoch; POST republishes it (the operator/driver move
-            # after a shard restarts on a new address)
+            # ring + epoch; POST republishes it — {"shards": ...} swaps
+            # the whole ring (the operator/driver move after a shard
+            # restarts on a new address), {"add"}/{"complete"}/{"remove"}
+            # are the elastic scale-out lifecycle (sharding/migrate.py)
             if req.method == "GET":
                 return Response.of_json(self._ring_doc())
             if req.method == "POST":
                 try:
                     body = json.loads(req.body) if req.body else {}
+                except ValueError as e:
+                    return _error_response(errors.BadRequestError(
+                        f"malformed JSON body: {e}"))
+                if not isinstance(body, dict):
+                    return _error_response(errors.BadRequestError(
+                        "body must be a JSON object"))
+                try:
+                    if "add" in body:
+                        return await self._ring_add(req, body["add"])
+                    if "complete" in body:
+                        ring = self.ring.without_override(
+                            str(body["complete"]))
+                        self.set_ring(ring)
+                        return Response.of_json(self._ring_doc())
+                    if "remove" in body:
+                        ring = self.ring.with_shard_removed(
+                            str(body["remove"]))
+                        self.set_ring(ring)
+                        return Response.of_json(self._ring_doc())
                     spec = body.get("shards", "")
                     if isinstance(spec, list):
                         spec = ",".join(
@@ -638,7 +692,17 @@ class RouterHandler:
                             + "".join("|" + r
                                       for r in s.get("replicas", ()))
                             for s in spec)
-                    ring = ShardRing.from_spec(spec)
+                    parsed = ShardRing.from_spec(spec)
+                    # a full republish keeps pending-migration pins whose
+                    # shards survived: a shard moving addresses mid-
+                    # migration must not silently flip pinned ownership
+                    keep = {s.name for s in parsed.shards}
+                    ring = ShardRing(
+                        list(parsed.shards),
+                        {c: n for c, n in self.ring.overrides.items()
+                         if n in keep})
+                except errors.ApiError as e:
+                    return _error_response(e)
                 except (ValueError, KeyError, TypeError) as e:
                     return _error_response(errors.BadRequestError(
                         f"malformed ring spec: {e}"))
@@ -852,6 +916,46 @@ class RouterHandler:
         names = sorted({c for _s, _h, b in results
                         for c in json.loads(b).get("clusters", [])})
         return Response.of_json({"clusters": names})
+
+    async def _ring_add(self, req: Request, entry) -> Response:
+        """Grow the ring by one shard (``POST /ring {"add": ...}``):
+        parse the entry, enumerate the fleet's live clusters, pin every
+        cluster whose HRW owner would change to its CURRENT owner (the
+        pending-migration overlay), and publish the grown ring. Nothing
+        moves yet: the response's ``pending`` list is the migration work
+        list — sharding/migrate.py streams each cluster to the new shard
+        and then posts ``{"complete": cluster}``, dropping that one pin
+        (the atomic per-cluster ownership flip). New clusters created
+        after the grow route straight to their HRW owners."""
+        if isinstance(entry, dict):
+            entry = (f"{entry['name']}={entry['url']}"
+                     + "".join("|" + r for r in entry.get("replicas", ())))
+        parsed = ShardRing.from_spec(str(entry))
+        if len(parsed.shards) != 1:
+            raise ValueError(
+                f"add takes exactly one shard entry, got {len(parsed.shards)}")
+        new = parsed.shards[0]
+        # the cluster enumeration must cover every shard or a missed
+        # cluster would flip owners without a migration (data loss):
+        # _scatter already refuses on any unreachable shard
+        results = await self._scatter("GET", "/clusters",
+                                      self._fwd_headers(req))
+        for s, h, b in results:
+            if s >= 400:
+                return self._relay(s, h, b)
+        clusters = sorted({c for _s, _h, b in results
+                           for c in json.loads(b).get("clusters", [])})
+        grown_names = [s.name for s in self.ring.shards] + [new.name]
+        movers = [
+            c for c in clusters
+            if c not in self.ring.overrides
+            and owner_name(grown_names, c)
+            != self.ring.shards[self.ring.owner_index(c)].name]
+        ring = self.ring.with_shard_added(new, movers)
+        self.set_ring(ring)
+        doc = self._ring_doc()
+        doc["pending"] = movers
+        return Response.of_json(doc)
 
     # ----------------------------------------------- fleet observability
 

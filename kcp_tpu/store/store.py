@@ -553,6 +553,14 @@ class LogicalStore:
         # watch answers a typed 410 instead of silently subscribing
         # "live" at a point the client is already past.
         self.reject_future_rv = False
+        # elastic scale-out (sharding/migrate.py): per-cluster write
+        # fences (cluster -> cutover RV) held while that cluster's data
+        # streams to its new owning shard, and per-cluster RV floors on
+        # the RECEIVING shard (cluster -> first post-migration RV) so a
+        # resume carrying a source-shard RV answers a typed 410 instead
+        # of silently resuming against an unrelated RV history.
+        self._cluster_fences: dict[str, int] = {}
+        self._migration_floors: dict[str, int] = {}
         self._objects: dict[Key, dict] = {}
         self._rv = 0
         self._watches: list[Watch] = []
@@ -829,6 +837,21 @@ class LogicalStore:
                     "newer replication epoch").inc()
             raise UnavailableError(f"store is read-only: {self.read_only}")
 
+    def _check_cluster_writable(self, cluster: str) -> None:
+        """Refuse writes to a cluster whose migration cutover is in
+        progress. 503 like the store-wide fence: the write belongs on
+        the cluster's NEW owner — clients retry, and by the time they
+        do the ring has flipped (the fence window is one WAL stream)."""
+        cut = self._cluster_fences.get(cluster)
+        if cut is not None:
+            REGISTRY.counter(
+                "migration_fenced_writes_total",
+                "writes refused because the cluster was fenced at its "
+                "migration cutover RV (retry lands on the new owner)").inc()
+            raise UnavailableError(
+                f"cluster {cluster!r} is migrating to a new shard "
+                f"(fenced at rv {cut}); retry")
+
     def _commit_trace(self, tctx, t0: float, key: Key, rv: int,
                       rec: dict, obj: dict | None) -> None:
         """Stamp a sampled write's trace onto its WAL record (``tc``
@@ -849,6 +872,7 @@ class LogicalStore:
     def create(self, resource: str, cluster: str, obj: dict, namespace: str = "") -> dict:
         self._race_guard.check()
         self._check_writable()
+        self._check_cluster_writable(cluster)
         tctx = obs.write_ctx()
         t0 = time.time() if tctx is not None else 0.0
         _inject("store.put")
@@ -919,6 +943,7 @@ class LogicalStore:
     ) -> dict:
         self._race_guard.check()
         self._check_writable()
+        self._check_cluster_writable(cluster)
         tctx = obs.write_ctx()
         t0 = time.time() if tctx is not None else 0.0
         _inject("store.put")
@@ -994,6 +1019,7 @@ class LogicalStore:
     def delete(self, resource: str, cluster: str, name: str, namespace: str = "") -> None:
         self._race_guard.check()
         self._check_writable()
+        self._check_cluster_writable(cluster)
         tctx = obs.write_ctx()
         t0 = time.time() if tctx is not None else 0.0
         _inject("store.delete")
@@ -1347,6 +1373,17 @@ class LogicalStore:
             raise GoneError(
                 f"requested rv {since_rv} is ahead of this replica's "
                 f"applied rv {self._rv}; re-list (or read the primary)")
+        if since_rv is not None and cluster != WILDCARD:
+            floor = self._migration_floors.get(cluster)
+            if floor is not None and since_rv < floor:
+                # the cluster migrated ONTO this shard at `floor`: any
+                # smaller rv was minted by the old owner's independent
+                # counter — resuming from it here would be a silent
+                # partial resume against an unrelated history. Typed
+                # 410: the client re-lists and resumes from local RVs.
+                raise GoneError(
+                    f"cluster {cluster} migrated onto this shard at rv "
+                    f"{floor}; rv {since_rv} predates the move — re-list")
         w = Watch(self, resource, cluster, namespace, selector or everything())
         if self._indexed and not w.selector.empty:
             self._subscribe_selector(w)
@@ -2053,16 +2090,149 @@ class LogicalStore:
             existing = self._objects.get(key)
             self._del_obj(key)
             self._rv = rv
-            if existing is not None:
+            if rec.get("mig"):
+                # a migration purge on the primary: the object MOVED to
+                # another shard, it was not deleted — no DELETED event
+                # (a phantom delete would evict live informer caches);
+                # cluster-scoped watchers on this replica are evicted to
+                # a typed 410 so they relist against the new owner.
+                for w in list(self._watches):
+                    if w.cluster == key[1]:
+                        w._evict()
+            elif existing is not None:
                 self._emit(DELETED, key, existing, rv, old=existing,
                            tc=tctx)
             out_rec = {"op": "del", "key": list(key), "rv": rv}
+            if rec.get("mig"):
+                out_rec["mig"] = 1
             if tctx is not None:
                 out_rec["tc"] = rec["tc"]
             self._log_wal(out_rec)
         else:
             raise InvalidError(f"unknown replication record op {op!r}")
         return True
+
+    # ----------------------------------------------------------- migration
+    #
+    # Live per-cluster migration (sharding/migrate.py): the source shard
+    # fences one cluster at a cutover RV, streams its objects to the new
+    # owner, the ring flips that one cluster, then the source purges it.
+    # Source and target mint RVs independently, so migrated objects get
+    # FRESH local RVs on the target and the source's RV history for the
+    # cluster becomes unreachable — the floor bookkeeping makes stale
+    # resumes answer a typed 410 instead of a silent partial resume.
+
+    def fence_cluster(self, cluster: str) -> int:
+        """Refuse further writes to one logical cluster and return the
+        cutover RV: every write this store ever acked for the cluster
+        has rv <= the returned value (the group-commit barrier flushes
+        in-flight windows first, so the replication window and the WAL
+        both already hold them). Idempotent."""
+        self._race_guard.check()
+        cut = self._cluster_fences.get(cluster)
+        if cut is not None:
+            return cut
+        self._gc_barrier()
+        self._flush_events()
+        self._cluster_fences[cluster] = self._rv
+        log.info("cluster %s fenced for migration at rv %d", cluster,
+                 self._rv)
+        return self._rv
+
+    def unfence_cluster(self, cluster: str) -> None:
+        """Roll back a cluster fence (an aborted migration)."""
+        self._race_guard.check()
+        self._cluster_fences.pop(cluster, None)
+
+    def apply_migrated(self, rec: dict) -> int | None:
+        """Apply one migrated record from a cluster moving ONTO this
+        shard. Unlike :meth:`apply_replicated`, the source's RVs mean
+        nothing here (independent counters): the object gets a fresh
+        local RV and only ``metadata.resourceVersion`` is re-stamped —
+        uid, creationTimestamp and every other byte survive the move.
+        Watch events fan out (ADDED for the common post-fence snapshot
+        case) so wildcard informers converge without a relist, and the
+        record lands in the local WAL. Returns the local rv, or None
+        for a no-op."""
+        self._race_guard.check()
+        self._check_writable()
+        op = rec.get("op")
+        if op == "epoch":
+            return None
+        key: Key = tuple(rec["key"])  # type: ignore[assignment]
+        REGISTRY.counter(
+            "migration_records_total",
+            "migrated WAL records applied on a cluster's new owning "
+            "shard").inc()
+        if op == "put":
+            obj = copy.deepcopy(rec["obj"])
+            old = self._objects.get(key)
+            rv = self._next_rv()
+            obj.setdefault("metadata", {})["resourceVersion"] = str(rv)
+            obj = self._put_obj(key, obj)
+            self._emit(MODIFIED if old is not None else ADDED, key, obj,
+                       rv, old=old)
+            self._log_wal({"op": "put", "key": list(key), "obj": obj,
+                           "rv": rv})
+            return rv
+        if op == "del":
+            existing = self._objects.get(key)
+            if existing is None:
+                return None
+            rv = self._next_rv()
+            self._del_obj(key)
+            self._emit(DELETED, key, existing, rv, old=existing)
+            self._log_wal({"op": "del", "key": list(key), "rv": rv})
+            return rv
+        raise InvalidError(f"unknown migration record op {op!r}")
+
+    def advance_rv(self, min_rv: int) -> None:
+        """Jump the RV counter to at least ``min_rv`` (never rewinds).
+        Used at migration finish so every RV this shard mints afterwards
+        sorts AFTER every RV the source ever minted for the cluster."""
+        self._race_guard.check()
+        min_rv = int(min_rv)
+        if min_rv > self._rv:
+            self._rv = min_rv
+            if self._engine is not None:
+                self._engine.set_rv(self._rv)
+
+    def finish_migration(self, cluster: str, source_rv: int) -> int:
+        """Target-side cutover bookkeeping: advance past everything the
+        source ever minted and record the cluster's RV floor — resumes
+        below it carry source-minted RVs and answer a typed 410 (see
+        :meth:`watch`). Returns the floor."""
+        self._race_guard.check()
+        self.advance_rv(int(source_rv) + 1)
+        self._migration_floors[cluster] = self._rv
+        return self._rv
+
+    def purge_cluster(self, cluster: str) -> int:
+        """Source-side teardown after the cluster's ownership flipped:
+        deliver everything already emitted, end the cluster's watch
+        streams through the eviction path (terminal typed 410 after
+        their buffers drain — nothing committed pre-cutover is lost),
+        then drop the cluster's objects WITHOUT watch events: the move
+        is not a delete, observers re-attach to the new owner. The WAL
+        del records (tagged ``mig``) keep restarts and WAL-fed replicas
+        consistent and wildcard scatter-lists duplicate-free. Returns
+        the number of objects purged."""
+        self._race_guard.check()
+        self._gc_barrier()
+        self._flush_events()
+        for w in list(self._watches):
+            if w.cluster == cluster:
+                w._evict()
+        keys = [k for k in self._objects if k[1] == cluster]
+        for key in keys:
+            rv = self._next_rv()
+            self._del_obj(key)
+            self._log_wal({"op": "del", "key": list(key), "rv": rv,
+                           "mig": 1})
+        self._cluster_fences.pop(cluster, None)
+        log.info("cluster %s purged after migration: %d objects", cluster,
+                 len(keys))
+        return len(keys)
 
     def reset_for_resync(self) -> None:
         """Drop all local state ahead of a full snapshot resync (the

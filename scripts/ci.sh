@@ -225,6 +225,38 @@ print("smartclient smoke ok: %sx direct/routed capacity (p99 %s->%sms) | bytes e
          drill["ring_epoch_after"]))
 '
 
+echo "== elastic: live scale-out smoke (fleet doubles mid-workload, zero lost acked writes, capacity floor)"
+# in-process fleet doubles 2->4 shards while smart + routed writers keep
+# going: every moving cluster's WAL streams to its new owner behind a
+# fence, the ring flips atomically per cluster, and the acked-write
+# ledger must come back intact. Floors: post-move capacity >=1.2x the
+# 2-shard baseline (the committed BENCH_r10_elastic.json measured 1.88x
+# on this shape; 1.2x leaves slack for loaded CI hosts while still
+# catching a migration that parks clusters or a ring that never flips),
+# zero lost acked writes, zero surfaced client errors (fence 503s are
+# absorbed by retry), and real migration traffic on the wire.
+el_line=$(KCP_BENCH_ELASTIC_SECONDS=0.8 KCP_BENCH_ELASTIC_CLUSTERS=16 \
+    python bench.py --elastic | tail -1)
+printf '%s\n' "$el_line" | python -c '
+import json, sys
+r = json.loads(sys.stdin.readline())
+eb = r["elastic_bench"]
+mv = eb["during_move"]
+assert r["value"] >= 1.2, "post-scale-out capacity %sx < 1.2x CI floor" % r["value"]
+assert mv["lost_after_move"] == 0, "acked writes lost across scale-out: %s" % mv
+assert mv["errors_surfaced"] == 0, "client errors surfaced during move: %s" % mv
+assert mv["migrated_clusters"] >= 1 and mv["migration_records"] >= 1, mv
+assert len(eb["per_shard_after"]) == eb["shards_after"], (
+    "scaled-out ring left shards idle: %s" % eb["per_shard_after"])
+print("elastic smoke ok: %sx capacity %d->%d shards | move %ss:"
+      " %d acked / 0 lost, %d clusters / %d records migrated,"
+      " %d fence 503s absorbed (epoch %d)"
+      % (r["value"], eb["shards_before"], eb["shards_after"],
+         mv["move_seconds"], mv["acked_writes"], mv["migrated_clusters"],
+         mv["migration_records"], mv["fenced_write_503s"],
+         mv["ring_epoch_after"]))
+'
+
 echo "== replica: HA replication smoke (read scaling, lag, kill-the-primary drill)"
 # primary + 0/1/2 WAL-fed read replicas, then a durable primary+standby
 # kill drill. Floors: read capacity >=1.5x at 2 replicas (each endpoint
@@ -366,7 +398,7 @@ echo "== scenarios: seeded end-to-end chaos smoke (churn + reconnect storm + kil
 # files; the full catalog (incl. rolling-restart drain-vs-kill) runs
 # via `scripts/scenarios.py run --all --seed 42`.
 JAX_PLATFORMS=cpu python scripts/scenarios.py run \
-    --scenarios crud-churn,reconnect-storm,kill-primary,ring-change-under-load \
+    --scenarios crud-churn,reconnect-storm,kill-primary,ring-change-under-load,scale-out-under-load \
     --seed 42 --scale 0.4 --out SCENARIOS_smoke.json
 python -c '
 import json

@@ -23,12 +23,22 @@ snapshot's RV watermark (the tool says so rather than guessing).
 
 Usage:
     python scripts/walreplay.py <root-dir-or-wal-path> [--rv N]
-        [--dump] [--keys] [--json]
+        [--dump] [--keys] [--json] [--cluster C] [--emit-ndjson]
 
-    --rv N   stop applying records with rv > N (default: the tip)
-    --dump   print every object (key -> JSON) at the target RV
-    --keys   print just the keys at the target RV
-    --json   machine-readable one-line summary
+    --rv N         stop applying records with rv > N (default: the tip)
+    --dump         print every object (key -> JSON) at the target RV
+    --keys         print just the keys at the target RV
+    --json         machine-readable one-line summary
+    --cluster C    restrict the reconstructed state to one logical
+                   cluster (the second key segment)
+    --emit-ndjson  print the reconstructed state as WAL-shaped put
+                   records (``{"op":"put","key":[...],"obj":{...}}``),
+                   one per line, instead of the summary — byte-for-byte
+                   the records a live migration streams off the fenced
+                   source's filtered feed, so this is BOTH the offline
+                   migration path (pipe to a shard's POST
+                   /migration/ingest) and the transport oracle the
+                   migration tests diff against
 """
 
 from __future__ import annotations
@@ -187,6 +197,13 @@ def main(argv: list[str] | None = None) -> int:
                     help="print just the keys at the target RV")
     ap.add_argument("--json", action="store_true",
                     help="one-line machine-readable summary")
+    ap.add_argument("--cluster", default=None,
+                    help="restrict the reconstructed state to one "
+                         "logical cluster (second key segment)")
+    ap.add_argument("--emit-ndjson", action="store_true",
+                    help="emit WAL-shaped put records (ndjson) for the "
+                         "reconstructed state — pipeable to a shard's "
+                         "POST /migration/ingest")
     args = ap.parse_args(argv)
 
     path = args.path
@@ -200,6 +217,27 @@ def main(argv: list[str] | None = None) -> int:
         print(f"warning: a snapshot compacted history up to rv "
               f"{st.floor_rv}; the earliest reachable state is rv "
               f"{st.floor_rv}, not {args.rv}", file=sys.stderr)
+    if args.cluster is not None:
+        # key layout: resource \x00 cluster \x00 namespace \x00 name —
+        # a cluster filter keeps exactly the keys a live migration moves
+        want = args.cluster.encode()
+        st.objects = {k: v for k, v in st.objects.items()
+                      if k.split(b"\x00")[1:2] == [want]}
+    if args.emit_ndjson:
+        # transport-oracle output: identical record shape to the fenced
+        # source's filtered feed (SNAP -> {"op":"put",...}); stdout is
+        # ONLY records so the stream pipes clean into /migration/ingest
+        for key in sorted(st.objects):
+            parts = key.decode("utf-8", "replace").split("\x00")
+            try:
+                obj = json.loads(st.objects[key])
+            except ValueError:
+                print(f"skipping non-JSON value at {'/'.join(parts)}",
+                      file=sys.stderr)
+                continue
+            print(json.dumps({"op": "put", "key": parts, "obj": obj},
+                             separators=(",", ":")))
+        return 0
     summary = {
         "wal": path,
         "target_rv": args.rv,
@@ -211,6 +249,8 @@ def main(argv: list[str] | None = None) -> int:
         "snapshot_floor_rv": st.floor_rv,
         "torn_bytes": st.torn_bytes,
     }
+    if args.cluster is not None:
+        summary["cluster"] = args.cluster
     if args.json:
         print(json.dumps(summary))
     else:

@@ -1,0 +1,420 @@
+"""Live shard scale-out + per-cluster WAL migration (PR 15).
+
+Drills the elastic-capacity stack bottom-up: the store's fence / floor /
+re-stamp primitives, watch-stream eviction through a live move, the
+``migrate.cutover`` kill drill (the fault point's required exercise —
+dying between migration finish and the ring flip must leave the fleet
+serving from the source), the walreplay ``--cluster --emit-ndjson``
+transport oracle, and the tentpole acceptance: a seeded workload run
+against a fleet that DOUBLES mid-workload is byte-identical (modulo
+per-store RV/timestamp stamps) to the same workload on an unmigrated
+monolith.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from helpers import shard_fleet
+from kcp_tpu import faults
+from kcp_tpu.server.rest import MultiClusterRestClient, RestClient
+from kcp_tpu.server.server import Config
+from kcp_tpu.server.threaded import ServerThread
+from kcp_tpu.sharding import migrate, owner_name
+from kcp_tpu.store.store import LogicalStore
+from kcp_tpu.utils import errors
+from test_sharding import (
+    _apply_ops,
+    _cm,
+    _norm,
+    _normalized_state,
+    _workload,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _movers(n_before: int, n_after: int, candidates=50):
+    """Cluster names that change owners when the ring grows from
+    ``n_before`` to ``n_after`` shards (HRW is deterministic on names,
+    so this is a pure function, not a probe)."""
+    old = [f"s{i}" for i in range(n_before)]
+    new = [f"s{i}" for i in range(n_after)]
+    out = []
+    for i in range(candidates):
+        c = f"c{i}"
+        if owner_name(old, c) != owner_name(new, c):
+            out.append(c)
+    return out
+
+
+def _stayers(n_before: int, n_after: int, candidates=50):
+    old = [f"s{i}" for i in range(n_before)]
+    new = [f"s{i}" for i in range(n_after)]
+    return [f"c{i}" for i in range(candidates)
+            if owner_name(old, f"c{i}") == owner_name(new, f"c{i}")]
+
+
+def _grow_shard(i: int) -> ServerThread:
+    """Start shard ``s<i>`` booted with the grown ring identity (the
+    shape RouterFleet.scale_out uses)."""
+    names = ",".join(f"s{j}" for j in range(i + 1))
+    return ServerThread(Config(durable=False, install_controllers=False,
+                               tls=False, shard_name=f"s{i}",
+                               ring_names=names, ring_epoch=1)).start()
+
+
+# ------------------------------------------------------ store primitives
+
+
+def test_fence_refuses_writes_and_unfence_restores():
+    s = LogicalStore()
+    s.create("configmaps", "ca", {"metadata": {"name": "a"}})
+    cut = s.fence_cluster("ca")
+    assert cut >= 1 and s.fence_cluster("ca") == cut  # idempotent
+    with pytest.raises(errors.UnavailableError):
+        s.create("configmaps", "ca", {"metadata": {"name": "b"}})
+    with pytest.raises(errors.UnavailableError):
+        s.delete("configmaps", "ca", "a")
+    # reads and OTHER clusters are untouched: the fence is per-cluster
+    assert s.get("configmaps", "ca", "a")["metadata"]["name"] == "a"
+    s.create("configmaps", "cb", {"metadata": {"name": "b"}})
+    s.unfence_cluster("ca")
+    s.create("configmaps", "ca", {"metadata": {"name": "b"}})
+    s.close()
+
+
+def test_apply_migrated_restamps_rv_and_preserves_identity():
+    src = LogicalStore()
+    made = src.create("configmaps", "ca", {"metadata": {"name": "a"},
+                                           "data": {"k": "v"}})
+    dst = LogicalStore()
+    dst.create("configmaps", "other", {"metadata": {"name": "x"}})
+    rec = {"op": "put",
+           "key": ["configmaps", "ca", "", "a"],
+           "obj": json.loads(json.dumps(made))}
+    rv = dst.apply_migrated(rec)
+    got = dst.get("configmaps", "ca", "a")
+    # fresh LOCAL rv (source counters mean nothing here)...
+    assert got["metadata"]["resourceVersion"] == str(rv)
+    assert rv != int(made["metadata"]["resourceVersion"]) or rv > 1
+    # ...but uid/creationTimestamp/payload survive byte-for-byte
+    assert got["metadata"]["uid"] == made["metadata"]["uid"]
+    assert (got["metadata"]["creationTimestamp"]
+            == made["metadata"]["creationTimestamp"])
+    assert got["data"] == {"k": "v"}
+    # epoch records are transport framing, not state
+    assert dst.apply_migrated({"op": "epoch", "epoch": 3}) is None
+    # del of an absent key is a no-op (idempotent re-streams)
+    assert dst.apply_migrated({"op": "del",
+                               "key": ["configmaps", "ca", "", "gone"],
+                               }) is None
+    src.close()
+    dst.close()
+
+
+def test_migration_floor_answers_410_on_stale_resume():
+    dst = LogicalStore()
+    dst.apply_migrated({"op": "put", "key": ["configmaps", "ca", "", "a"],
+                        "obj": {"metadata": {"name": "a"}}})
+    floor = dst.finish_migration("ca", source_rv=500)
+    assert dst._rv >= 501  # every future rv sorts after the source's
+    # a source-minted resume rv answers an honest typed 410
+    with pytest.raises(errors.GoneError):
+        dst.watch("configmaps", cluster="ca", since_rv=7)
+    # resumes at/after the floor, and other clusters, are fine
+    dst.watch("configmaps", cluster="ca", since_rv=floor).close()
+    dst.watch("configmaps", cluster="cb", since_rv=None).close()
+    dst.close()
+
+
+def test_purge_drops_objects_without_delete_events():
+    s = LogicalStore()
+    w_mover = s.watch("configmaps", cluster="ca")
+    w_other = s.watch("configmaps", cluster="cb")
+    for n in ("a", "b"):
+        s.create("configmaps", "ca", {"metadata": {"name": n}})
+    s.create("configmaps", "cb", {"metadata": {"name": "keep"}})
+    assert s.purge_cluster("ca") == 2
+    s._flush_events()
+
+    async def drain(w):
+        evs = []
+        try:
+            while True:
+                evs.append(await asyncio.wait_for(w.__anext__(), 0.3))
+        except (StopAsyncIteration, asyncio.TimeoutError, errors.GoneError):
+            pass
+        return evs
+
+    # the mover's watch ends via EVICTION (typed 410 relist), with the
+    # pre-purge ADDED events delivered first and no DELETED events — a
+    # move is not a delete
+    evs = asyncio.run(drain(w_mover))
+    assert [e.type for e in evs] == ["ADDED", "ADDED"]
+    assert w_mover.evicted
+    # the bystander's stream stays open and saw nothing new
+    assert not w_other.evicted
+    w_other.close()
+    assert s.get("configmaps", "cb", "keep")
+    with pytest.raises(errors.NotFoundError):
+        s.get("configmaps", "ca", "a")
+    s.close()
+
+
+# --------------------------------------------------- live fleet behavior
+
+
+def test_live_scale_out_moves_cluster_and_keeps_serving():
+    mover = _movers(2, 3)[0]
+    stayer = _stayers(2, 3)[0]
+    with shard_fleet(2) as (router, shards, ring):
+        for cl in (mover, stayer):
+            c = RestClient(router.address, cl)
+            for i in range(4):
+                c.create("configmaps", _cm(f"m{i}", cl, {"i": str(i)}))
+            c.close()
+        new = _grow_shard(2)
+        try:
+            out = migrate.scale_out(router.address, f"s2={new.address}")
+            assert mover in out["pending"]
+            assert out["records"] >= 4
+            c = RestClient(router.address, mover)
+            items, _rv = c.list("configmaps", "default")
+            assert {o["metadata"]["name"] for o in items} == {
+                f"m{i}" for i in range(4)}
+            # post-flip writes land on the new owner
+            c.create("configmaps", _cm("post", mover, {}))
+            doc = c._request("GET", "/ring")
+            assert len(doc["shards"]) == 3 and not doc["overrides"]
+            c.close()
+            # the source purged the moved cluster (no wildcard dupes)
+            src = next(t for t in shards
+                       if t.server.config.shard_name
+                       == owner_name(["s0", "s1"], mover))
+            assert not any(k[1] == mover
+                           for k in src.server.store._objects)
+            assert sum(1 for k in new.server.store._objects
+                       if k[1] == mover) == 5
+        finally:
+            new.stop()
+
+
+def test_watch_rides_migration_with_typed_410_relist():
+    mover = _movers(2, 3)[0]
+    with shard_fleet(2) as (router, _shards, _ring):
+        rc = RestClient(router.address, mover)
+        rc.create("configmaps", _cm("w0", mover, {"i": "0"}))
+
+        async def main():
+            w = rc.watch("configmaps")
+            await w.next_batch(0.05)
+            await asyncio.sleep(0.2)
+            rc.create("configmaps", _cm("w1", mover, {"i": "1"}))
+            got = []
+            for _ in range(100):
+                got.extend(await w.next_batch(0.05))
+                if got:
+                    break
+            assert got and got[0].name == "w1"
+            new = _grow_shard(2)
+            try:
+                migrate.scale_out(router.address, f"s2={new.address}")
+                # the source's purge ends the stream with a terminal
+                # typed 410 — the informer contract: relist, never hang
+                with pytest.raises(errors.GoneError):
+                    for _ in range(200):
+                        await w.next_batch(0.05)
+                w.close()
+                # the relist against the new owner sees every object
+                items, rv = rc.list("configmaps", "default")
+                assert {o["metadata"]["name"] for o in items} == {
+                    "w0", "w1"}
+                # and a fresh watch from the relist RV serves new events
+                w2 = rc.watch("configmaps", since_rv=rv)
+                await w2.next_batch(0.05)
+                await asyncio.sleep(0.2)
+                rc.create("configmaps", _cm("w2", mover, {"i": "2"}))
+                got2 = []
+                for _ in range(100):
+                    got2.extend(await w2.next_batch(0.05))
+                    if got2:
+                        break
+                assert got2 and got2[0].name == "w2"
+                w2.close()
+            finally:
+                new.stop()
+
+        asyncio.run(main())
+        rc.close()
+
+
+def test_cutover_fault_drill_rolls_back_then_retry_completes():
+    """The ``migrate.cutover`` drill: die at the WORST instant — target
+    loaded, ring not yet flipped. The fence must roll back (the fleet
+    keeps serving from the source) and a bare retry must complete the
+    move (idempotent re-stream + upsert)."""
+    mover = _movers(2, 3)[0]
+    with shard_fleet(2) as (router, shards, _ring):
+        rc = RestClient(router.address, mover)
+        for i in range(3):
+            rc.create("configmaps", _cm(f"d{i}", mover, {"i": str(i)}))
+        new = _grow_shard(2)
+        faults.install(faults.FaultInjector("migrate.cutover:raise",
+                                            seed=1))
+        try:
+            with pytest.raises(faults.InjectedFault):
+                migrate.scale_out(router.address, f"s2={new.address}")
+            # rollback: ownership never flipped (the pin survives), the
+            # fence lifted, and the SOURCE still serves reads AND writes
+            doc = rc._request("GET", "/ring")
+            assert doc["overrides"].get(mover) == owner_name(
+                ["s0", "s1"], mover)
+            items, _rv = rc.list("configmaps", "default")
+            assert len(items) == 3
+            rc.create("configmaps", _cm("post-abort", mover, {}))
+            faults.clear()
+            # the retry (per pending cluster, off the ring doc — the
+            # grown ring is already published) completes the move and
+            # carries the post-abort write with it
+            for cluster in sorted(doc["overrides"]):
+                out = migrate.migrate_cluster(router.address, cluster)
+                assert out["target"] == owner_name(
+                    ["s0", "s1", "s2"], cluster)
+            doc = rc._request("GET", "/ring")
+            assert not doc["overrides"]
+            items, _rv = rc.list("configmaps", "default")
+            assert {o["metadata"]["name"] for o in items} == {
+                "d0", "d1", "d2", "post-abort"}
+            src = next(t for t in shards
+                       if t.server.config.shard_name
+                       == owner_name(["s0", "s1"], mover))
+            assert not any(k[1] == mover
+                           for k in src.server.store._objects)
+        finally:
+            faults.clear()
+            rc.close()
+            new.stop()
+
+
+def test_fence_window_answers_503_through_router():
+    mover = _movers(2, 3)[0]
+    with shard_fleet(2) as (router, shards, _ring):
+        rc = RestClient(router.address, mover)
+        rc.create("configmaps", _cm("f0", mover, {}))
+        src_url = next(t.address for t in shards
+                       if t.server.config.shard_name
+                       == owner_name(["s0", "s1"], mover))
+        migrate._req(src_url, "POST", "/migration/fence",
+                     {"cluster": mover})
+        try:
+            # a fenced write is a typed 503 — the client's plain retry
+            # discipline covers the window, nothing special-cased
+            with pytest.raises(errors.UnavailableError):
+                rc.create("configmaps", _cm("f1", mover, {}))
+            # reads keep working mid-window
+            assert rc.get("configmaps", "f0", "default")
+        finally:
+            migrate._req(src_url, "POST", "/migration/unfence",
+                         {"cluster": mover})
+        rc.create("configmaps", _cm("f1", mover, {}))
+        rc.close()
+
+
+# -------------------------------------------- walreplay transport oracle
+
+
+def test_walreplay_cluster_ndjson_matches_live_feed(tmp_path):
+    """``walreplay.py --cluster --emit-ndjson`` must reproduce EXACTLY
+    the records a live migration streams off the fenced source — the
+    offline transport is the oracle for the online one."""
+    mover = _movers(2, 3)[0]
+    stayer = _stayers(2, 3)[0]
+    with shard_fleet(2, durable=True, root_dir=str(tmp_path)) as (
+            router, shards, _ring):
+        for cl in (mover, stayer):
+            c = RestClient(router.address, cl)
+            for i in range(5):
+                c.create("configmaps", _cm(f"o{i}", cl, {"i": str(i)}))
+            c.delete("configmaps", "o1", "default")
+            c.close()
+        src = next(t for t in shards
+                   if t.server.config.shard_name
+                   == owner_name(["s0", "s1"], mover))
+        live, barrier = migrate.fetch_cluster_records(src.address, mover)
+        assert barrier > 0
+        root = src.server.config.root_dir
+        out = subprocess.run(
+            [sys.executable, str(REPO / "scripts" / "walreplay.py"),
+             root, "--cluster", mover, "--emit-ndjson"],
+            capture_output=True, text=True, timeout=60, check=True)
+        offline = [json.loads(line) for line in out.stdout.splitlines()
+                   if line.strip()]
+        key = lambda r: tuple(r["key"])  # noqa: E731
+        assert sorted(offline, key=key) == sorted(live, key=key)
+        assert len(offline) == 4  # o1 deleted; stayer filtered out
+        # and the records actually LOAD: ingest into a fresh store
+        dst = LogicalStore()
+        for rec in offline:
+            dst.apply_migrated(rec)
+        assert sorted(k[3] for k in dst._objects) == [
+            "o0", "o2", "o3", "o4"]
+        dst.close()
+
+
+# ------------------------------------------- tentpole differential fuzz
+
+
+@pytest.mark.parametrize("seed", [29])
+def test_migrated_fleet_differential_fuzz(seed):
+    """The acceptance bar: a seeded workload whose fleet DOUBLES (2->4,
+    one shard at a time, migrations live) mid-workload ends
+    byte-identical — modulo per-store RV/timestamp stamps — to the same
+    workload against an unmigrated monolith."""
+    clusters = [f"c{i}" for i in range(8)]
+    assert set(_movers(2, 4)) & set(clusters)  # the move is real
+    ops = _workload(seed, clusters, 100)
+    split = 50
+
+    with ServerThread(Config(durable=False, install_controllers=False,
+                             tls=False)) as mono:
+        wc = MultiClusterRestClient(mono.address)
+        _apply_ops(wc, ops)
+        want = _normalized_state(wc)
+        wc.close()
+
+    with shard_fleet(2) as (router, _shards, _ring):
+        wc = MultiClusterRestClient(router.address)
+        _apply_ops(wc, ops[:split])
+        grown: list[ServerThread] = []
+        try:
+            moved = 0
+            for i in (2, 3):
+                t = _grow_shard(i)
+                grown.append(t)
+                out = migrate.scale_out(router.address,
+                                        f"s{i}={t.address}")
+                moved += out["records"]
+            assert moved >= 1
+            # retry=True: the second half may race residual fence 503s
+            _apply_ops(wc, ops[split:], retry=True)
+            deadline = time.time() + 30
+            while True:
+                got = _normalized_state(wc)
+                if got == want or time.time() > deadline:
+                    break
+                time.sleep(0.2)
+            assert got == want
+            doc = RestClient(router.address)._request("GET", "/ring")
+            assert len(doc["shards"]) == 4 and not doc["overrides"]
+        finally:
+            wc.close()
+            for t in grown:
+                t.stop()

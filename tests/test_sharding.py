@@ -77,6 +77,48 @@ def test_ring_spec_parse():
         ShardRing.from_spec("a=http://h:1,a=http://h:2")  # dup name
 
 
+def test_ring_rejects_duplicates_with_actionable_errors():
+    # duplicate NAMES collapse two ring identities into one: rejected at
+    # parse time, naming both URLs so the operator can fix the entry
+    with pytest.raises(ValueError, match="h0:1.*h0:2|duplicate shard name"):
+        ShardRing.from_spec("a=http://h0:1,a=http://h0:2")
+    # duplicate URLS route two distinct keyspaces at one server: equally
+    # a config typo, equally rejected up front (not at first request)
+    with pytest.raises(ValueError, match="duplicate shard url"):
+        ShardRing.from_spec("a=http://h0:1,b=http://h0:1")
+    with pytest.raises(ValueError, match="duplicate shard url"):
+        ShardRing.from_spec("a=http://h0:1, http://h0:1")  # named + bare
+    # pending-migration overrides must name shards that exist
+    with pytest.raises(ValueError, match="not in the ring"):
+        ShardRing([Shard("a", "http://h0:1")], {"c1": "ghost"})
+
+
+def test_ring_override_pins_cluster_until_dropped():
+    base = _ring(3)
+    grown = base.with_shard_added(Shard("s3", "http://h3:1"))
+    moved = [f"tenant-{i}" for i in range(200)
+             if grown.shards[grown.owner_index(f"tenant-{i}")].name == "s3"]
+    pinned = base.with_shard_added(Shard("s3", "http://h3:1"),
+                                   pin_clusters=moved)
+    for c in moved:
+        # pinned: still served by the OLD owner mid-migration
+        assert (pinned.shards[pinned.owner_index(c)].name
+                == base.shards[base.owner_index(c)].name)
+        # hrw_index ignores pins: it names the migration TARGET
+        assert pinned.shards[pinned.hrw_index(c)].name == "s3"
+    # dropping a pin flips that one cluster; the rest stay pinned
+    flipped = pinned.without_override(moved[0])
+    assert flipped.shards[flipped.owner_index(moved[0])].name == "s3"
+    for c in moved[1:]:
+        assert flipped.shards[flipped.owner_index(c)].name != "s3"
+    with pytest.raises(ValueError):
+        flipped.without_override(moved[0])  # no such pending migration
+    # a shard with clusters still pinned to it cannot be removed
+    with pytest.raises(ValueError, match="pending migrations"):
+        pinned.with_shard_removed(
+            base.shards[base.owner_index(moved[0])].name)
+
+
 # --------------------------------------------------------------- rvmap
 
 
@@ -346,6 +388,54 @@ def test_merged_watch_rejects_scalar_rv_with_410(fleet):
                 pass
 
     asyncio.run(main())
+
+
+def test_merged_watch_vector_rv_across_ring_growth_is_410(fleet):
+    """A wildcard vector RV is a position in ONE ring's shard order;
+    after the fleet grows (live scale-out), a resume carrying the old
+    3-shard vector must answer an honest typed 410 — strict decode
+    (vector-for-N is not a vector-for-N+1), never a silent partial
+    resume — and a fresh list+resume against the grown ring works."""
+    from kcp_tpu.server.server import Config
+    from kcp_tpu.server.threaded import ServerThread
+    from kcp_tpu.sharding import migrate
+
+    router, shards, ring = fleet
+    wc = MultiClusterRestClient(router.address)
+    wc.create("configmaps", _cm("g0", "c0", {"i": "0"}))
+    _items, old_rv = wc.list("configmaps")
+    assert decode_rvmap(old_rv, 3) is not None  # minted under 3 shards
+    new = ServerThread(Config(durable=False, install_controllers=False,
+                              tls=False, shard_name="s3",
+                              ring_names="s0,s1,s2,s3",
+                              ring_epoch=1)).start()
+    try:
+        migrate.scale_out(router.address, f"s3={new.address}")
+        assert decode_rvmap(old_rv, 4) is None  # strict: wrong ring size
+
+        async def main():
+            w = wc.watch("configmaps", since_rv=old_rv)
+            with pytest.raises(errors.GoneError):
+                async for _ in w:
+                    pass
+            # the relist mints a 4-shard vector that resumes cleanly
+            _items2, rv2 = wc.list("configmaps")
+            assert decode_rvmap(rv2, 4) is not None
+            w2 = wc.watch("configmaps", since_rv=rv2)
+            await w2.next_batch(0.05)
+            await asyncio.sleep(0.2)
+            wc.create("configmaps", _cm("g1", "c0", {"i": "1"}))
+            got = []
+            for _ in range(200):
+                got.extend(await w2.next_batch(0.05))
+                if got:
+                    break
+            assert got and got[0].name == "g1"
+            w2.close()
+
+        asyncio.run(main())
+    finally:
+        new.stop()
 
 
 def test_shard_death_fails_fast_and_terminates_watch(fleet):
