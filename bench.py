@@ -1461,6 +1461,348 @@ def encode_bench() -> int:
     return 0
 
 
+def _pagination_cm(i: int) -> dict:
+    # same realistic ~0.5 KiB shape as the encode bench: the allocation
+    # the page bound caps scales with per-object size
+    return {
+        "apiVersion": "v1", "kind": "ConfigMap",
+        "metadata": {"name": f"cm-{i:06d}", "namespace": f"ns{i % 8}",
+                     "uid": f"uid-{i}",
+                     "labels": {"team": f"t{i % 64}", "tier": str(i % 7)},
+                     "annotations": {
+                         "kcp.dev/owned-by": f"workspace-{i % 128}",
+                         "kubectl.kubernetes.io/last-applied-configuration":
+                             f"cm-{i}/rev-0",
+                         "config.example.dev/checksum": f"{i:08x}{i:08x}",
+                     }},
+        "data": {"server.yaml": f"replicas: {i % 9}\nshard: {i % 64}\n",
+                 "feature-flags": f"a={i % 2},b={i % 3},c={i % 5}",
+                 "rev": "0"},
+    }
+
+
+def _pagination_ab(n_objects: int, page: int) -> dict:
+    """One paged-vs-unpaged relist A/B through the real RestHandler:
+    peak allocation (tracemalloc) of a full one-shot relist vs iterating
+    limit/continue pages holding at most one page at a time — with the
+    concatenated page bytes proven sha256-identical to the one-shot
+    ``items`` span. Used by ``--pagination`` and embedded in the
+    gauntlet scorecard as the relist-memory column."""
+    import asyncio
+    import hashlib
+    import tracemalloc
+
+    from kcp_tpu.apis.scheme import default_scheme
+    from kcp_tpu.server.handler import RestHandler
+    from kcp_tpu.server.httpd import Request
+    from kcp_tpu.store.store import LogicalStore
+
+    marker = b'"items": ['
+    rv_re = re.compile(rb'"resourceVersion": "(\d+)"')
+    cont_re = re.compile(rb'"continue": "([^"]*)"')
+
+    def span_of(body: bytes) -> bytes:
+        i = body.find(marker)
+        assert i >= 0 and body.endswith(b"]}")
+        return body[i + len(marker):-2]
+
+    def head_meta(body: bytes) -> tuple[str, str]:
+        """(rv, continue) parsed from the envelope head bytes alone —
+        what a streaming client reads; never materializes item dicts."""
+        head = body[:body.find(marker)]
+        rv_m = rv_re.search(head)
+        cont_m = cont_re.search(head)
+        return (rv_m.group(1).decode() if rv_m else "",
+                cont_m.group(1).decode() if cont_m else "")
+
+    async def run() -> dict:
+        store = LogicalStore(indexed=True, encode_cache=True,
+                             clock=lambda: 1_700_000_000.0)
+        handler = RestHandler(store, default_scheme(), admission=None)
+        for i in range(n_objects):
+            store.create("configmaps", f"c{i % 16}", _pagination_cm(i))
+        path = "/clusters/*/api/v1/configmaps"
+        # warm the per-record byte cache outside both timed/traced
+        # windows so the A/B measures body assembly, not first-encode
+        await handler(Request("GET", path, {}, {}, b""))
+
+        tracemalloc.start()
+        tracemalloc.reset_peak()
+        base = tracemalloc.get_traced_memory()[0]
+        t0 = time.perf_counter()
+        resp = await handler(Request("GET", path, {}, {}, b""))
+        body = resp.body
+        unpaged_s = time.perf_counter() - t0
+        unpaged_peak = tracemalloc.get_traced_memory()[1] - base
+        tracemalloc.stop()
+        # verification outside the traced window: neither arm's peak
+        # should include the A/B's own proof bookkeeping
+        one_shot_sha = hashlib.sha256(span_of(body)).hexdigest()
+        rv, _ = head_meta(body)
+        del resp, body
+
+        tracemalloc.start()
+        tracemalloc.reset_peak()
+        base = tracemalloc.get_traced_memory()[0]
+        digest = hashlib.sha256()
+        pages = 0
+        cont = None
+        first = True
+        rv_paged = None
+        t0 = time.perf_counter()
+        while True:
+            q = {"limit": [str(page)]}
+            if cont:
+                q["continue"] = [cont]
+            resp = await handler(Request("GET", path, q, {}, b""))
+            body = resp.body
+            pages += 1
+            # hash through a memoryview: the page's items bytes feed the
+            # equality proof without a second whole-page copy
+            i = body.find(marker)
+            assert i >= 0 and body.endswith(b"]}")
+            if len(body) - i - len(marker) > 2:
+                if not first:
+                    digest.update(b", ")
+                digest.update(memoryview(body)[i + len(marker):-2])
+                first = False
+            page_rv, cont = head_meta(body)
+            if rv_paged is None:
+                rv_paged = page_rv
+            del resp, body
+            if not cont:
+                break
+        paged_s = time.perf_counter() - t0
+        paged_peak = tracemalloc.get_traced_memory()[1] - base
+        tracemalloc.stop()
+        store.close()
+        handler.close()
+        return {
+            "objects": n_objects, "page": page, "pages": pages,
+            "rv_equal": rv == rv_paged,
+            "bytes_equal": digest.hexdigest() == one_shot_sha,
+            "sha256": one_shot_sha,
+            "unpaged_peak_kb": round(unpaged_peak / 1024),
+            "paged_peak_kb": round(paged_peak / 1024),
+            "peak_cut": round(unpaged_peak / max(paged_peak, 1), 2),
+            "unpaged_s": round(unpaged_s, 4),
+            "paged_s": round(paged_s, 4),
+        }
+
+    return asyncio.run(run())
+
+
+def pagination_bench() -> int:
+    """Paged-relist A/B (``--pagination``): peak relist allocation with
+    one-shot lists vs limit/continue pages at the BASELINE 100k-object
+    watch-fan-out shape. The headline is the peak-allocation cut; the
+    run self-verifies that concatenated pages are byte-identical to the
+    one-shot body (anything else is a paging bug, not a measurement)."""
+    n_objects = int(os.environ.get("KCP_BENCH_PAG_OBJECTS", "100000"))
+    page = int(os.environ.get("KCP_BENCH_PAG_PAGE", "10000"))
+    ab = _pagination_ab(n_objects, page)
+    emit({
+        "metric": "paged_relist_peak_cut",
+        "value": ab["peak_cut"],
+        "unit": "x",
+        "pagination_bench": ab,
+    })
+    return 0
+
+
+def gauntlet_bench() -> int:
+    """The north-star gauntlet (``--gauntlet``): one composed run per
+    BASELINE.json config — router + shard fleets + replicas, smart
+    clients as the default write driver — each scored by the scenario
+    engine (reconciles/sec as acked-writes/sec, spec->status
+    convergence p50/p99 from assembled trace phases, per-phase RSS) and
+    emitted as one scorecard row. A paged-relist A/B at the 100k-object
+    fan-out shape rides the scorecard as the relist-memory column.
+
+    Knobs: KCP_GAUNTLET_SCALE (divisor, default 50 — CI runs 1/50th of
+    BASELINE shape; 1 is the full gauntlet), KCP_GAUNTLET_CONFIGS (csv
+    of config indices, default all), KCP_GAUNTLET_SOAK (repeat each
+    config's phases N times so the RSS-growth SLO spans a soak, with a
+    scorecard snapshot per round), KCP_GAUNTLET_OPS (override ops per
+    tenant per phase), KCP_GAUNTLET_OUT (also write the scorecard to a
+    file), KCP_BENCH_PAG_OBJECTS/_PAGE (relist A/B shape)."""
+    import dataclasses
+
+    from kcp_tpu.scenarios.engine import run_scenario
+    from kcp_tpu.scenarios.spec import SLO, Phase, ScenarioSpec
+    from kcp_tpu.utils.trace import REGISTRY
+
+    divisor = float(os.environ.get("KCP_GAUNTLET_SCALE", "50"))
+    scale = 1.0 / max(divisor, 1e-9)
+    soak = int(os.environ.get("KCP_GAUNTLET_SOAK", "0"))
+    ops_override = os.environ.get("KCP_GAUNTLET_OPS", "")
+    out_path = os.environ.get("KCP_GAUNTLET_OUT", "")
+
+    try:
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BASELINE.json"), encoding="utf-8") as f:
+            cfg_names = list(json.load(f).get("configs", []))
+    except OSError:
+        cfg_names = []
+
+    slos_common = (
+        SLO("no-lost-acked-writes", "lost_acked_writes", "==", 0),
+        SLO("no-lost-watch-events", "lost_watch_events", "==", 0),
+        SLO("bounded-rss-growth", "memory_growth_ratio", "<=", 3.0),
+    )
+    slos_crd = (
+        SLO("no-lost-acked-cr-writes", "lost_acked_writes", "==", 0),
+        SLO("all-crds-established", "crd_unestablished", "==", 0),
+        SLO("bounded-rss-growth", "memory_growth_ratio", "<=", 3.0),
+    )
+    phases = (Phase("warm", ops_per_tenant=8),
+              Phase("sustain", ops_per_tenant=24, settle_s=0.5),
+              Phase("drain", ops_per_tenant=8, settle_s=0.5))
+    # one full-scale spec per BASELINE.json config line, in file order;
+    # .scaled() brings each down to 1/KCP_GAUNTLET_SCALE of the
+    # BASELINE shape (SLO targets never scale)
+    specs = [
+        # contrib/demo: splitter over 2 physical clusters, 1 logical
+        ScenarioSpec(
+            name="gauntlet-demo",
+            description="demo shape: 2-shard fleet, a handful of "
+                        "logical clusters, smart-client writers",
+            topology="fleet", topology_args={"shards": 2},
+            tenants=100, watchers_per_tenant=1, phases=phases,
+            options={"smart_all": True}, slos=slos_common),
+        # syncer diff batched across 1k logical clusters (cm churn)
+        ScenarioSpec(
+            name="gauntlet-syncer-churn",
+            description="1k-logical-cluster ConfigMap churn through a "
+                        "durable 4-shard fleet, smart-client writers",
+            topology="fleet", topology_args={"shards": 4, "durable": True},
+            tenants=1000, watchers_per_tenant=1, phases=phases,
+            options={"smart_all": True}, slos=slos_common),
+        # splitter bin-packing across 10k workspaces x 8 pclusters
+        ScenarioSpec(
+            name="gauntlet-splitter-10k",
+            description="10k-workspace write fan-in across a 4-shard "
+                        "fleet (the 10k-logical-cluster north-star "
+                        "shape), smart-client writers",
+            topology="fleet", topology_args={"shards": 4},
+            tenants=10000, watchers_per_tenant=0, phases=phases,
+            options={"smart_all": True},
+            slos=(SLO("no-lost-acked-writes", "lost_acked_writes",
+                      "==", 0),
+                  SLO("bounded-rss-growth", "memory_growth_ratio",
+                      "<=", 3.0))),
+        # NegotiatedAPIResource schema-compat across 5k tenant CRD sets
+        ScenarioSpec(
+            name="gauntlet-crd-5k",
+            description="5k-tenant CRD establish/negotiate churn with "
+                        "live CR traffic (schema-compat reconcile)",
+            topology="monolith", topology_args={"controllers": True},
+            tenants=5000, watchers_per_tenant=0, workload="crd",
+            phases=(Phase("establish", ops_per_tenant=10, settle_s=0.5),
+                    Phase("negotiate", ops_per_tenant=16, settle_s=0.5)),
+            slos=slos_crd),
+        # informer watch fan-out: 100k objects, 10k watchers
+        ScenarioSpec(
+            name="gauntlet-watch-fanout",
+            description="watch fan-out at the 10k-watcher shape: 100 "
+                        "tenants x 100 streams over one server process "
+                        "under sustained churn",
+            topology="monolith", topology_args={"proc": True},
+            tenants=100, watchers_per_tenant=100, phases=phases,
+            options={"pace_s": 0.01, "coverage_timeout_s": 120.0},
+            slos=slos_common),
+    ]
+    sel_env = os.environ.get("KCP_GAUNTLET_CONFIGS", "")
+    selected = ([int(x) for x in sel_env.split(",") if x.strip() != ""]
+                if sel_env else list(range(len(specs))))
+
+    # a FRESH workdir per invocation: fleet shards are durable by
+    # default, and a reused root would replay a previous run's WAL
+    # into this run's fold (stale objects -> phantom 409s/losses)
+    import shutil
+    import tempfile
+    workdir = tempfile.mkdtemp(prefix="kcp-gauntlet-")
+
+    rows = []
+    degraded_any = False
+    for idx in selected:
+        spec = specs[idx]
+        if ops_override:
+            n = int(ops_override)
+            spec = dataclasses.replace(spec, phases=tuple(
+                dataclasses.replace(p, ops_per_tenant=n if p.ops_per_tenant
+                                    else 0) for p in spec.phases))
+        if soak > 1:
+            # soak mode: the same phase block repeated N rounds under
+            # one topology — RSS is sampled at every phase boundary, so
+            # rss_kb_per_phase is the periodic snapshot series and the
+            # growth SLO spans the whole soak
+            spec = dataclasses.replace(spec, phases=tuple(
+                dataclasses.replace(p, name=f"{p.name}-r{r}")
+                for r in range(soak) for p in spec.phases))
+        cfg = (cfg_names[idx] if idx < len(cfg_names)
+               else f"config[{idx}]")
+        print(f"# gauntlet [{idx}] {spec.name}: {cfg}", file=sys.stderr)
+        try:
+            res = run_scenario(spec, seed=42, scale=scale,
+                               workdir=workdir)
+        except Exception as e:  # noqa: BLE001 - a wedged config must
+            # not take down the other rows; the failure IS the row
+            rows.append({"config": cfg, "name": spec.name,
+                         "scale": f"1/{divisor:g}", "passed": False,
+                         "degraded": True, "error": f"{type(e).__name__}: {e}"})
+            degraded_any = True
+            continue
+        m = res.get("measurements", {})
+        row = {
+            "config": cfg,
+            "name": spec.name,
+            "scale": f"1/{divisor:g}",
+            "tenants": res.get("tenants"),
+            "reconciles_per_sec": m.get("acked_per_sec"),
+            "acked": m.get("acked"),
+            "convergence_p50_ms": m.get("p50_convergence_ms"),
+            "convergence_p99_ms": m.get("p99_convergence_ms"),
+            "lost_acked_writes": m.get("lost_acked_writes"),
+            "lost_watch_events": m.get("lost_watch_events"),
+            "rss_kb_per_phase": m.get("rss_kb_per_phase"),
+            "memory_growth_ratio": m.get("memory_growth_ratio"),
+            "duration_s": m.get("duration_s"),
+            "passed": res.get("passed"),
+            "slos": res.get("slos"),
+        }
+        if res.get("aborted"):
+            row["degraded"] = True
+            row["error"] = res["aborted"]
+            degraded_any = True
+        rows.append(row)
+
+    shutil.rmtree(workdir, ignore_errors=True)
+    pag = _pagination_ab(
+        int(os.environ.get("KCP_BENCH_PAG_OBJECTS", "100000")),
+        int(os.environ.get("KCP_BENCH_PAG_PAGE", "10000")))
+    REGISTRY.counter(
+        "gauntlet_runs_total",
+        "composed gauntlet scorecard runs completed").inc()
+    scorecard = {
+        "metric": "gauntlet_configs_passed",
+        "value": sum(1 for r in rows if r.get("passed")),
+        "unit": f"of {len(rows)} configs",
+        "scale": f"1/{divisor:g}",
+        "soak_rounds": soak,
+        "rows": rows,
+        "relist": pag,
+    }
+    if degraded_any:
+        scorecard["degraded"] = True
+    if out_path:
+        with open(out_path, "w", encoding="utf-8") as f:
+            json.dump(scorecard, f, indent=1)
+            f.write("\n")
+    emit(scorecard)
+    return 0
+
+
 def _spawn_kcp(extra_args: list[str], timeout: float = 60.0):
     """Spawn a real ``kcp start`` subprocess (plaintext, no controllers,
     no syncer) and block until it announces its serving address. Returns
@@ -4182,7 +4524,8 @@ if __name__ == "__main__":
             or "--sharded" in args or "--replica" in args
             or "--watchers" in args or "--trace" in args
             or "--smartclient" in args or "--writes" in args
-            or "--elastic" in args):
+            or "--elastic" in args or "--pagination" in args
+            or "--gauntlet" in args):
         # pure-host microbenches: pin CPU (never touch the tunnel)
         # and run in-process — no watchdog child needed
         try:
@@ -4200,6 +4543,8 @@ if __name__ == "__main__":
                  else smartclient_bench() if "--smartclient" in args
                  else elastic_bench() if "--elastic" in args
                  else writes_bench() if "--writes" in args
+                 else pagination_bench() if "--pagination" in args
+                 else gauntlet_bench() if "--gauntlet" in args
                  else encode_bench())
     if "--probe" in args:
         # manual diagnostic: always run in-process (never through the

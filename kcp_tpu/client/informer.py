@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import random
 from typing import Awaitable, Callable, Iterable
 
@@ -67,6 +68,7 @@ class Informer:
         selector: LabelSelector | None = None,
         namespace: str | None = None,
         resync_period: float | None = None,
+        watch_list: bool | None = None,
     ):
         self.client = client
         self.gvr = gvr
@@ -90,6 +92,16 @@ class Informer:
         # no resync) — so a stream dropped after a quiet period resumes
         # inside the watch window instead of relisting the world
         self._rv = 0
+        # KEP-3157-style watch-list start (opt-in: ctor arg, or
+        # KCP_WATCH_LIST=1): the initial state arrives as ADDED events
+        # on the watch stream itself, ending in a sync BOOKMARK — the
+        # informer never holds a whole list body. Only clients that
+        # advertise support (RestClient family) use it; others (and any
+        # refusal at runtime) fall back to classic list+watch.
+        if watch_list is None:
+            watch_list = os.environ.get("KCP_WATCH_LIST", "") == "1"
+        self._watch_list = bool(watch_list) and bool(
+            getattr(client, "supports_watch_list", False))
 
     def _retry_delay(self, err: BaseException | None) -> float:
         """Reflector retry pacing: the flat rewatch backoff, unless the
@@ -186,18 +198,65 @@ class Informer:
     # --------------------------------------------------------------- run
 
     async def start(self) -> None:
-        """List, populate, open the watch, and start the pump task."""
-        items, rv = self.client.list(self.gvr, self.namespace, self.selector)
-        for obj in items:
-            self._apply(ADDED, obj)
-        self._rv = max(self._rv, rv)
-        self._watch = self.client.watch(
-            self.gvr, self.namespace, self.selector, since_rv=rv
-        )
+        """List, populate, open the watch, and start the pump task.
+
+        In watch-list mode the list+watch is ONE stream: the server
+        sends the current state as ADDED events, then the sync BOOKMARK
+        that marks the cache consistent, and the same stream carries the
+        live tail — the informer is synced without ever buffering a
+        whole list response."""
+        started = False
+        if self._watch_list:
+            started = await self._start_watch_list()
+        if not started:
+            items, rv = self.client.list(self.gvr, self.namespace,
+                                         self.selector)
+            for obj in items:
+                self._apply(ADDED, obj)
+            self._rv = max(self._rv, rv)
+            self._watch = self.client.watch(
+                self.gvr, self.namespace, self.selector, since_rv=rv
+            )
         self._synced.set()
         self._task = asyncio.create_task(self._pump())
         if self.resync_period:
             self._resync_task = asyncio.create_task(self._resync_loop())
+
+    async def _start_watch_list(self) -> bool:
+        """Consume initial ADDED events until the server's
+        initial-events-end BOOKMARK, then keep the very same stream as
+        the live watch. False (with the partial state discarded by
+        replace-semantics on the fallback list) on any refusal — an
+        older server, a router wildcard — so start() degrades to
+        classic list+watch."""
+        try:
+            w = self.client.watch(self.gvr, self.namespace, self.selector,
+                                  initial_events=True)
+        except Exception:  # noqa: BLE001 — client can't even build it
+            log.warning("informer %s: watch-list unsupported; falling "
+                        "back to list+watch", self.gvr, exc_info=True)
+            return False
+        try:
+            async for ev in w:
+                if ev.type == "BOOKMARK":
+                    self._rv = max(self._rv, ev.rv,
+                                   getattr(w, "last_rv", 0) or 0)
+                    self._watch = w
+                    REGISTRY.counter(
+                        "informer_watch_list_starts_total",
+                        "informer syncs served as one watch-list "
+                        "stream (no whole-list buffering)").inc()
+                    return True
+                self._apply(ev.type, ev.object)
+                if ev.rv:
+                    self._rv = max(self._rv, ev.rv)
+            # stream ended before the sync marker (refusal or drop)
+        except Exception:  # noqa: BLE001 — server refused (400/410/...)
+            pass
+        log.warning("informer %s: watch-list start failed; falling back "
+                    "to list+watch", self.gvr)
+        w.close()
+        return False
 
     async def _pump(self) -> None:
         """Dispatch watch events; on stream end, resume or re-list.

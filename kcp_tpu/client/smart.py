@@ -286,7 +286,8 @@ class SmartRestClient(RestClient):
 
     def watch(self, gvr, namespace: str | None = None, selector=None,
               since_rv: int | None = None,
-              bookmarks: bool = True) -> RestWatch:
+              bookmarks: bool = True,
+              initial_events: bool = False) -> RestWatch:
         """Open a watch stream DIRECT to the owning shard when the ring
         allows (carrying the epoch header); routed otherwise. A direct
         stream that dies or 410s lands in the informer's normal
@@ -294,7 +295,8 @@ class SmartRestClient(RestClient):
         :meth:`_roundtrip`, which refreshes the ring and falls back, so
         a moved shard converges without special watch-side plumbing."""
         routed = super().watch(gvr, namespace, selector,
-                               since_rv=since_rv, bookmarks=bookmarks)
+                               since_rv=since_rv, bookmarks=bookmarks,
+                               initial_events=initial_events)
         if self.cluster == WILDCARD:
             return routed
         ring, epoch = self._ring_snapshot()
@@ -315,7 +317,8 @@ class SmartRestClient(RestClient):
         _DIRECT.inc()
         return RestWatch(host, port, routed._path, routed.resource,
                          token=self.token, ssl_context=pool.ssl_context,
-                         extra_headers={RING_EPOCH_HEADER: str(epoch)})
+                         extra_headers={RING_EPOCH_HEADER: str(epoch)},
+                         initial_events=initial_events)
 
     # ---------------------------------------------------------- lifecycle
 

@@ -245,29 +245,45 @@ class ReplicationHub:
                 "type": "HEADER", "epoch": self.store.epoch, "rv": rv_now,
                 "sub": sub.sid, "snapshot": need_snapshot,
             }).encode() + b"\n"
+            # keys only, never an (key, obj) pair list: a whole-store
+            # snapshot must not pin every object dict for the life of
+            # the stream (under churn that doubles resident state; on
+            # the migration transport the cluster is large by
+            # definition). Objects are fetched per batch at send time —
+            # migration clusters are fenced first so the bytes are the
+            # final state; a standby's snapshot converges through the
+            # live queue it registered for above (idempotent puts, and
+            # a key deleted mid-stream is skipped here because its
+            # DELETE record follows).
             if cluster is not None:
-                snapshot = [(k, o) for k, o in self.store._objects.items()
-                            if k[1] == cluster]
+                snap_keys = [k for k in self.store._objects
+                             if k[1] == cluster]
             elif need_snapshot:
-                snapshot = list(self.store._objects.items())
+                snap_keys = list(self.store._objects)
             else:
-                snapshot = []
+                snap_keys = []
                 tail = [line for rv, line in self._records
                         if since_rv < rv <= rv_now]
             await stream.send_spans([header])
             if need_snapshot:
+                objects = self.store._objects
+                shipped = 0
                 batch: list[bytes] = []
-                for key, obj in snapshot:
+                for key in snap_keys:
+                    obj = objects.get(key)
+                    if obj is None:
+                        continue
                     batch.append(json.dumps(
                         {"type": "SNAP", "key": list(key), "obj": obj},
                         separators=(",", ":")).encode() + b"\n")
+                    shipped += 1
                     if len(batch) >= 256:
                         await stream.send_spans(batch)
                         batch = []
                 batch.append(json.dumps(
                     {"type": "BARRIER", "rv": rv_now}).encode() + b"\n")
                 await stream.send_spans(batch)
-                self._shipped.inc(len(snapshot))
+                self._shipped.inc(shipped)
                 if cluster is not None:
                     # migration transport ends at the barrier: the
                     # cluster is fenced, nothing more can follow

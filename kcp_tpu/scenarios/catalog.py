@@ -93,6 +93,12 @@ RECONNECT_STORM = ScenarioSpec(
         SLO("convergence", "p99_convergence_ms", "<=", 30000.0),
         SLO("no-lost-acked-writes", "lost_acked_writes", "==", 0),
         SLO("error-budget-5xx", "http_5xx", "==", 0),
+        # soak memory: server RSS at the last phase boundary vs the
+        # first. 10k resumes each relisting the world is exactly where
+        # unpaged list bodies balloon; paged relists keep this flat.
+        # Declared (not best-effort) so a run where RSS sampling broke
+        # FAILS as "metric never measured" instead of passing blind.
+        SLO("bounded-rss-growth", "memory_growth_ratio", "<=", 3.0),
     ),
 )
 

@@ -25,6 +25,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import math
+import os
 import time
 
 from .. import faults as faults_mod
@@ -72,6 +73,25 @@ def pctile(vals: list[float], q: float) -> float:
 # ---------------------------------------------------------------------------
 
 
+def _topology_rss_kb(topology) -> int | None:
+    """Resident-set size (kB) of the process actually serving the
+    topology: the spawned child for a proc-mode Monolith, this process
+    for in-thread topologies (RouterFleet / ReplicatedPrimary servers
+    live in our ServerThreads). None when /proc isn't readable — the
+    soak SLO then fails loudly as "never measured" instead of passing
+    on a hole in the data."""
+    child = getattr(topology, "_child", None)
+    pid = child.pid if child is not None else os.getpid()
+    try:
+        with open(f"/proc/{pid}/status", encoding="ascii") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1])
+    except (OSError, ValueError, IndexError):
+        return None
+    return None
+
+
 async def _run_action(action: str, topology, observers, loop) -> None:
     """Fire a phase's chaos action once the writers are under way."""
     await asyncio.sleep(0.25)
@@ -113,11 +133,13 @@ def run_crd_tenant(base_url: str, tenant: str, ops, phase_idx: int,
                    stats: WriterStats, shared: dict) -> None:
     """One tenant's CRD lifecycle slice (blocking worker thread).
 
-    Phase 0: create the tenant's CRD, measure create→servable latency
-    (the schema-negotiation convergence the BASELINE config lanes care
-    about), then churn CRs. Phase 1+: update the CRD schema (negotiation
-    churn), churn more CRs, verify the fold, then tear the CRD down and
-    measure create→404 teardown latency."""
+    Even phases: create the tenant's CRD, measure create→servable
+    latency (the schema-negotiation convergence the BASELINE config
+    lanes care about), then churn CRs. Odd phases: update the CRD
+    schema (negotiation churn), churn more CRs, verify the fold, then
+    tear the CRD down and measure create→404 teardown latency. The
+    lifecycle is a 2-beat cycle, so a soak run repeating the
+    establish/negotiate block N times runs N full lifecycles."""
     from ..apis import crd as crdapi
 
     group = f"{tenant}.scenario.kcp.dev"
@@ -132,7 +154,7 @@ def run_crd_tenant(base_url: str, tenant: str, ops, phase_idx: int,
                 "spec": {"v": step}}
 
     try:
-        if phase_idx == 0:
+        if phase_idx % 2 == 0:
             crd = crdapi.new_crd(group, "v1", "widgets", "Widget")
             crd["metadata"]["clusterName"] = tenant
             t0 = time.monotonic()
@@ -185,17 +207,29 @@ def run_crd_tenant(base_url: str, tenant: str, ops, phase_idx: int,
                     time.sleep(0.05)
         with stats._lock:
             shared[("live", tenant)] = live
-        if phase_idx > 0:
+        if phase_idx % 2 == 1:
             # verify the fold against the server BEFORE teardown
             items, _rv = c.list(resource, NAMESPACE)
             have = {o["metadata"]["name"] for o in items}
             lost = len(live - have) + len(have - live)
             with stats._lock:
                 shared["cr_lost"] = shared.get("cr_lost", 0) + lost
-            # teardown: delete the CRD; the endpoint must 404 promptly
+            # teardown: reap the surviving CRs first (the store does
+            # not GC CR objects with their CRD — a later lifecycle
+            # recreating the CRD would resurrect them into its fold),
+            # then delete the CRD; the endpoint must 404 promptly
+            for name in live:
+                try:
+                    c.delete(resource, name, NAMESPACE)
+                except errors.ApiError:
+                    pass
             t0 = time.monotonic()
             c.delete("customresourcedefinitions.apiextensions.k8s.io",
                      f"widgets.{group}", "")
+            # the CRs died with the CRD: reset the fold so a soak's
+            # next lifecycle starts from an honest empty ledger
+            with stats._lock:
+                shared[("live", tenant)] = set()
             deadline = time.monotonic() + 30.0
             while time.monotonic() < deadline:
                 try:
@@ -254,14 +288,19 @@ async def _drive(sspec: ScenarioSpec, seed: int, schedule, topology,
                 else:
                     # smart_half: even-index tenants write DIRECT over
                     # the ring (SmartRestClient), odd ones stay routed —
-                    # the same seeded schedule through both paths
+                    # the same seeded schedule through both paths.
+                    # smart_all: every tenant direct — the gauntlet's
+                    # default driver shape (smart clients are the
+                    # production common case since the router-hop cut).
                     smart_half = bool(sspec.options.get("smart_half"))
+                    smart_all = bool(sspec.options.get("smart_all"))
                     for ti, ops in enumerate(schedule[phase.name]):
                         if ops:
                             writer_futs.append(loop.run_in_executor(
                                 None, run_writer, base, tenant_name(ti),
                                 ops, stats, phase.name, "quiet", 30.0,
-                                pace, smart_half and ti % 2 == 0))
+                                pace,
+                                smart_all or (smart_half and ti % 2 == 0)))
                 flood_fut = None
                 if phase.action == "flood":
                     flood_fut = loop.run_in_executor(
@@ -287,6 +326,11 @@ async def _drive(sspec: ScenarioSpec, seed: int, schedule, topology,
                 None, _fetch_slowest_traces, base)
             if traces:
                 measurements.setdefault("_traces", {})[phase.name] = traces
+            # soak accounting: RSS at every phase boundary, so a run's
+            # scorecard shows WHERE memory went, not just that it grew
+            rss = _topology_rss_kb(topology)
+            if rss is not None:
+                measurements.setdefault("_rss", {})[phase.name] = rss
             if phase.settle_s:
                 await asyncio.sleep(phase.settle_s)
         # coverage settle: give observers time to catch up with every
@@ -431,6 +475,20 @@ def _collect(sspec: ScenarioSpec, stats: WriterStats, observers,
     m["ambiguous_acks"] = stats.ambiguous
     m["gave_up"] = stats.gave_up
     m["duration_s"] = round(duration_s, 3)
+    if duration_s > 0:
+        m["acked_per_sec"] = round(len(acks) / duration_s, 3)
+    # soak memory SLO inputs: per-phase RSS plus last/first growth
+    # ratio. Deliberately ABSENT (not defaulted) when sampling failed:
+    # a scenario declaring `memory_growth_ratio` then scores
+    # "metric never measured" and fails — per the no-silent-holes
+    # discipline, an unmeasured SLO is a failing SLO.
+    rss = m.pop("_rss", None)
+    if rss:
+        m["rss_kb_per_phase"] = dict(rss)
+        first = next(iter(rss.values()))
+        last = list(rss.values())[-1]
+        if first > 0:
+            m["memory_growth_ratio"] = round(last / first, 3)
     # per-phase writer p99: what a client-visible op cost during each
     # phase — the ring-change scenario bounds the fallback window's
     # (`phase_move_p99_ms`) so "the move was absorbed" is a latency
@@ -455,8 +513,14 @@ def _collect(sspec: ScenarioSpec, stats: WriterStats, observers,
             pctile(crd.get("teardown_s", []), 0.99) * 1000, 3)
         m["crd_established"] = len(crd.get("servable_s", []))
         m["crd_torn_down"] = len(crd.get("teardown_s", []))
-        m["crd_unestablished"] = sspec.tenants - m["crd_established"]
-        m["crd_undestroyed"] = sspec.tenants - m["crd_torn_down"]
+        # one establish per even phase, one teardown per odd phase —
+        # a soak run's repeated lifecycle multiplies the expectation
+        up_beats = (len(sspec.phases) + 1) // 2
+        down_beats = len(sspec.phases) // 2
+        m["crd_unestablished"] = (sspec.tenants * up_beats
+                                  - m["crd_established"])
+        m["crd_undestroyed"] = (sspec.tenants * down_beats
+                                - m["crd_torn_down"])
         m["lost_acked_writes"] = crd.get("cr_lost", 0)
     for name in TRACKED_COUNTERS:
         short = name[:-len("_total")]

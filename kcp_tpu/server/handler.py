@@ -27,7 +27,7 @@ from .. import obs
 from ..admission.chain import NOOP_TICKET
 from ..apis.scheme import GVR, ResourceInfo, Scheme
 from ..store.selectors import parse_selector
-from ..store.store import WILDCARD, LogicalStore
+from ..store.store import INITIAL_EVENTS_END, WILDCARD, LogicalStore
 from ..utils import errors
 from ..utils.routing import resolve_write_cluster
 from ..utils.trace import REGISTRY
@@ -607,6 +607,20 @@ class RestHandler:
                 if req.param("watch") in ("true", "1"):
                     return self._watch(req, cluster, res, namespace or None)
                 selector = parse_selector(req.param("labelSelector"))
+                limit_s = req.param("limit")
+                cont = req.param("continue")
+                if ((limit_s or cont) and not as_table
+                        and hasattr(self.store, "list_page")):
+                    try:
+                        limit = int(limit_s) if limit_s else 0
+                    except ValueError:
+                        raise errors.BadRequestError(
+                            f"malformed limit {limit_s!r}") from None
+                    if limit < 0:
+                        raise errors.BadRequestError("limit must be >= 0")
+                    return await self._list_page(
+                        req, cluster, res, namespace, selector, info, gv,
+                        limit, cont or None)
                 if self._encode and not as_table:
                     return await self._list_encoded(
                         req, cluster, res, namespace, selector, info, gv)
@@ -781,6 +795,55 @@ class RestHandler:
                     and ck not in self._list_cache):
                 self._list_cache.pop(next(iter(self._list_cache)))
             self._list_cache[ck] = (rv, tuple(parts), total)
+        return Response(spans=parts)
+
+    async def _list_page(self, req: Request, cluster: str, res: str,
+                         namespace: str, selector, info: ResourceInfo,
+                         gv: str, limit: int, cont: str | None) -> Response:
+        """KEP-365 chunked list serving: one RV-pinned page per request.
+
+        Pages skip the RV-keyed whole-body cache (each page is its own
+        body) but ride the same span-splice envelope as
+        :meth:`_list_encoded` — a page is assembled from bucket-span
+        slices, never a whole-body join. ``metadata`` keeps
+        ``resourceVersion`` first so the router's vector-RV rewrite and
+        continue-token splice anchor on it. A continue token the store's
+        watch window no longer covers raises typed ``GoneError`` →
+        HTTP 410, and the client restarts its chunked list."""
+        t0 = time.perf_counter()
+        if self._encode and selector.empty and self._spans:
+            spans, rv, nxt = await self._st(
+                self.store.list_encoded_page, res, cluster,
+                namespace or None, limit, cont)
+        else:
+            items, rv, nxt = await self._st(
+                self.store.list_page, res, cluster, namespace or None,
+                selector, limit, cont)
+            if not self._encode:
+                meta: dict = {"resourceVersion": str(rv)}
+                if nxt:
+                    meta["continue"] = nxt
+                resp = Response.of_json({
+                    "kind": info.list_kind, "apiVersion": gv,
+                    "metadata": meta, "items": items,
+                })
+                self._enc_seconds.observe(time.perf_counter() - t0)
+                return resp
+            spans = self.store.encode_many(items) if items else []
+        meta = {"resourceVersion": str(rv)}
+        if nxt:
+            meta["continue"] = nxt
+        head = json.dumps({
+            "kind": info.list_kind, "apiVersion": gv,
+            "metadata": meta, "items": [],
+        }).encode()
+        parts = [head[:-2]]
+        for i, span in enumerate(spans):
+            if i:
+                parts.append(b", ")
+            parts.append(span)
+        parts.append(b"]}")
+        self._enc_seconds.observe(time.perf_counter() - t0)
         return Response(spans=parts)
 
     def _get_encoded(self, res: str, cluster: str, name: str,
@@ -1147,15 +1210,40 @@ class RestHandler:
                 f"timeoutSeconds must be a finite non-negative number, "
                 f"got {timeout_s!r}")
         bookmarks = req.param("allowWatchBookmarks") in ("true", "1")
+        initial_events = req.param("sendInitialEvents") in ("true", "1")
+        if initial_events and self._remote:
+            # a storage frontend would have to buffer the backend's
+            # whole list to re-serve it — exactly what watch-list
+            # exists to avoid; the client falls back to list+watch
+            raise errors.BadRequestError(
+                "sendInitialEvents is not supported on a storage "
+                "frontend; list+watch instead")
         # bookmark cadence (KCP_WATCH_BOOKMARK_S): frequent enough that
         # resuming clients lose little window, cheap enough to be noise
         # (apiserver uses ~1/min; our watch windows are smaller)
         bookmark_every = self._bookmark_every
 
         async def produce(stream: StreamResponse) -> None:
+            init_items = init_rv = None
             try:
-                watch = await self._st(
-                    self.store.watch, res, cluster, namespace, selector, since_rv)
+                if initial_events:
+                    # KEP-3157-style watch-list: open the watch and take
+                    # the list snapshot in ONE store-loop step, so no
+                    # event can fall between them — the ADDED stream
+                    # plus the live tail is exactly list-then-watch,
+                    # without the client ever holding a whole list body
+                    def _open_watch_list():
+                        w = self.store.watch(
+                            res, cluster, namespace, selector, None)
+                        items, rv = self.store.list(
+                            res, cluster, namespace, selector)
+                        return w, items, rv
+                    watch, init_items, init_rv = await self._st(
+                        _open_watch_list)
+                else:
+                    watch = await self._st(
+                        self.store.watch, res, cluster, namespace,
+                        selector, since_rv)
             except errors.ConflictError as e:
                 # expired watch window → 410 Gone in-stream, like the
                 # apiserver's "too old resource version"
@@ -1171,6 +1259,36 @@ class RestHandler:
                     "type": "ERROR",
                     "object": _status_body(e.code, e.reason, e.message)})
                 return
+            if init_items is not None:
+                # stream the snapshot as ADDED events in bounded
+                # batches, then the sync BOOKMARK that marks the end of
+                # initial events — the client is consistent at init_rv
+                # and keeps this very stream for the live tail
+                send_raw = (getattr(stream, "send_raw_many", None)
+                            if self._encode else None)
+                if send_raw is not None:
+                    batch: list[bytes] = []
+                    for obj in init_items:
+                        batch.append(b'{"type": "ADDED", "object": '
+                                     + self.store.encode_obj(obj) + b"}\n")
+                        if len(batch) >= 512:
+                            await send_raw(batch)
+                            batch = []
+                    if batch:
+                        await send_raw(batch)
+                else:
+                    for obj in init_items:
+                        await stream.send_json(
+                            {"type": "ADDED", "object": obj})
+                await stream.send_json({
+                    "type": "BOOKMARK",
+                    "object": {"kind": "Bookmark", "metadata": {
+                        "resourceVersion": str(init_rv),
+                        "annotations": {INITIAL_EVENTS_END: "true"}}},
+                })
+                REGISTRY.counter(
+                    "watch_list_streams_total",
+                    "watch streams opened with sendInitialEvents").inc()
             loop = asyncio.get_event_loop()
             deadline = loop.time() + timeout if timeout else None
             drain_task: asyncio.Task | None = None

@@ -18,6 +18,7 @@ import asyncio
 import codecs
 import http.client
 import json
+import os
 import threading
 import time
 from urllib.parse import quote, urlsplit
@@ -27,7 +28,7 @@ from ..analysis.sanitize import make_lock
 from ..apis.scheme import GVR, ResourceInfo, Scheme, default_scheme
 from ..faults import maybe_fail, should_drop
 from ..store.selectors import LabelSelector
-from ..store.store import WILDCARD, Event
+from ..store.store import INITIAL_EVENTS_END, WILDCARD, Event
 from ..utils import errors
 from ..utils.circuit import CircuitBreaker
 from ..utils.routing import resolve_write_cluster
@@ -99,6 +100,16 @@ def _raise_for_status(code: int, body: bytes,
     raise err
 
 
+def _list_page_size() -> int:
+    """Transparent list-chunking page size (KCP_LIST_PAGE, default
+    10000; ``0`` restores the legacy one-shot list — the A/B lane).
+    Read per call so tests and scenario phases can flip it live."""
+    try:
+        return int(os.environ.get("KCP_LIST_PAGE", "10000") or "0")
+    except ValueError:
+        return 10000
+
+
 class RestWatch:
     """Async iterator over a server watch stream, yielding store Events.
 
@@ -107,14 +118,22 @@ class RestWatch:
     :meth:`drain`, :meth:`close`.
     """
 
+    # class-level default so a skeletal instance (tests build one via
+    # ``__new__`` to drive ``_feed`` directly) still parses bookmarks
+    _initial_events = False
+
     def __init__(self, host: str, port: int, path: str, resource: str,
                  token: str = "", ssl_context=None,
-                 extra_headers: dict[str, str] | None = None):
+                 extra_headers: dict[str, str] | None = None,
+                 initial_events: bool = False):
         self._host = host
         self._port = port
         self._path = path
         self._token = token
         self._ssl = ssl_context
+        # watch-list mode: the initial-events-end BOOKMARK is yielded
+        # (instead of absorbed) so the informer knows when it is synced
+        self._initial_events = initial_events
         # extra request headers (the smart client's X-Kcp-Ring-Epoch
         # stamp on direct-to-shard watches rides here)
         self._extra_headers = extra_headers or {}
@@ -239,12 +258,21 @@ class RestWatch:
             self._events.put_nowait(None)
             return
         if msg.get("type") == "BOOKMARK":
-            # progress marker: remember the RV for resume, emit nothing
+            # progress marker: remember the RV for resume, emit nothing —
+            # EXCEPT the watch-list sync marker, which the consumer needs
+            # to see to know its initial ADDED stream is complete
             meta = (msg.get("object") or {}).get("metadata") or {}
             try:
-                self.last_rv = int(meta.get("resourceVersion", "0"))
+                rv = int(meta.get("resourceVersion", "0"))
+                self.last_rv = rv
             except ValueError:
-                pass
+                rv = 0
+            if (self._initial_events and (meta.get("annotations") or {})
+                    .get(INITIAL_EVENTS_END) == "true"):
+                self._events.put_nowait(Event(
+                    type="BOOKMARK", resource=self.resource, cluster="",
+                    namespace="", name="", object=msg.get("object") or {},
+                    rv=rv))
             return
         obj = msg["object"]
         meta = obj.get("metadata") or {}
@@ -591,25 +619,70 @@ class RestClient:
         res = self._resource_name(gvr)
         return self._request("GET", self._path(res, namespace, name))
 
+    # paged list iteration is transparent, so informers relist in
+    # bounded pages — and servers that page can also watch-list
+    supports_watch_list = True
+
     def list(self, gvr: GVR | str, namespace: str | None = None,
-             selector: LabelSelector | None = None) -> tuple[list[dict], int]:
+             selector: LabelSelector | None = None,
+             limit: int | None = None) -> tuple[list[dict], int]:
+        """List, paging transparently (KEP-365): ``KCP_LIST_PAGE``
+        (default 10000) bounds how much any one response buffers;
+        ``limit`` overrides per call; ``0`` restores the legacy one-shot
+        list. The returned RV is the first page's pin — every follow-up
+        page is served *at that RV*, so the concatenation is exactly the
+        one-shot list. A continue token that outlives the server's watch
+        window answers 410: the chunked list restarts from scratch once,
+        then propagates."""
         res = self._resource_name(gvr)
-        query = ""
+        base_q = []
         if selector is not None and not selector.empty:
-            query = "labelSelector=" + quote(str(selector))
-        body = self._request("GET", self._path(res, namespace, query=query))
-        rv = int((body.get("metadata") or {}).get("resourceVersion", "0"))
-        return body.get("items", []), rv
+            base_q.append("labelSelector=" + quote(str(selector)))
+        page = _list_page_size() if limit is None else limit
+        if page <= 0:
+            body = self._request(
+                "GET", self._path(res, namespace, query="&".join(base_q)))
+            rv = int((body.get("metadata") or {})
+                     .get("resourceVersion", "0"))
+            return body.get("items", []), rv
+        items: list[dict] = []
+        rv = 0
+        cont = ""
+        restarted = False
+        while True:
+            q = list(base_q) + [f"limit={page}"]
+            if cont:
+                q.append("continue=" + quote(cont, safe=""))
+            try:
+                body = self._request(
+                    "GET", self._path(res, namespace, query="&".join(q)))
+            except errors.GoneError:
+                if not cont or restarted:
+                    raise
+                items, cont, rv, restarted = [], "", 0, True
+                continue
+            meta = body.get("metadata") or {}
+            if not cont:
+                rv = int(meta.get("resourceVersion", "0"))
+            items.extend(body.get("items", []))
+            cont = meta.get("continue") or ""
+            if not cont:
+                return items, rv
 
     def watch(self, gvr: GVR | str, namespace: str | None = None,
               selector: LabelSelector | None = None,
               since_rv: int | None = None,
-              bookmarks: bool = True) -> RestWatch:
+              bookmarks: bool = True,
+              initial_events: bool = False) -> RestWatch:
         """Open a watch stream. ``bookmarks`` (default on, KEP-1904
         style) asks the server for periodic BOOKMARK progress markers:
         RestWatch absorbs them into ``last_rv`` without yielding, so a
         stream dropped after a quiet period resumes from a fresh RV
-        inside the watch window instead of 410ing into a relist."""
+        inside the watch window instead of 410ing into a relist.
+        ``initial_events`` (KEP-3157 style) asks the server to stream
+        the current state as ADDED events first, ending with a sync
+        BOOKMARK that RestWatch *yields* — list+watch in one stream,
+        never holding a whole list body (``since_rv`` must be None)."""
         res = self._resource_name(gvr)
         query = "watch=true"
         if selector is not None and not selector.empty:
@@ -618,9 +691,12 @@ class RestClient:
             query += f"&resourceVersion={since_rv}"
         if bookmarks:
             query += "&allowWatchBookmarks=true"
+        if initial_events:
+            query += "&sendInitialEvents=true"
         path = self._path(res, namespace, query=query)
         return RestWatch(self._host, self._port, path, res, token=self.token,
-                         ssl_context=self._ssl)
+                         ssl_context=self._ssl,
+                         initial_events=initial_events)
 
     # ------------------------------------------------------------- writes
 

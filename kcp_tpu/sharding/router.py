@@ -41,6 +41,7 @@ relay path). Metrics: ``router_proxy_seconds``,
 from __future__ import annotations
 
 import asyncio
+import base64
 import http.client
 import json
 import logging
@@ -57,7 +58,7 @@ from ..server.handler import CLUSTER_HEADER, DEFAULT_CLUSTER, _error_response, _
 from ..server.httpd import Request, Response, StreamResponse
 from ..server.rest import RestWatch
 from ..store.remote import ConnectionPool
-from ..store.store import WILDCARD
+from ..store.store import WILDCARD, encode_continue
 from ..utils import errors
 from ..utils.routing import resolve_write_cluster
 from ..utils.trace import REGISTRY
@@ -68,6 +69,42 @@ log = logging.getLogger(__name__)
 
 _ITEMS_MARKER = b'"items": ['
 _RV_RE = re.compile(rb'"resourceVersion": "(\d+)"')
+_CONT_RE = re.compile(rb'"continue": "([^"]*)"')
+
+
+def _encode_router_continue(rvs: list[int], toks: list) -> str:
+    """Pack every shard's pinned RV and per-shard store continue token
+    into ONE opaque client token — the paged analogue of the vector RV."""
+    raw = json.dumps({"v": 1, "n": len(rvs), "r": rvs, "t": toks},
+                     separators=(",", ":")).encode()
+    return base64.urlsafe_b64encode(raw).decode()
+
+
+def _decode_router_continue(token: str, n: int):
+    """``(rvs, toks)`` or None when the token is malformed or was minted
+    against a different shard topology (callers answer typed 410)."""
+    try:
+        d = json.loads(base64.urlsafe_b64decode(token.encode()))
+        if d.get("v") != 1 or d.get("n") != n:
+            return None
+        rvs, toks = d["r"], d["t"]
+        if len(rvs) != n or len(toks) != n:
+            return None
+        if not all(t is None or isinstance(t, str) for t in toks):
+            return None
+        return [int(x) for x in rvs], list(toks)
+    except (ValueError, KeyError, TypeError):
+        return None
+
+
+def _swap_continue(target: str, token: str) -> str:
+    """The original request target with its ``continue`` query value
+    replaced by a shard-local token (limit/labelSelector relay as-is)."""
+    path, _sep, query = target.partition("?")
+    parts = [p for p in query.split("&")
+             if p and not p.startswith("continue=")]
+    parts.append("continue=" + quote(token, safe=""))
+    return path + "?" + "&".join(parts)
 _SAMPLE_RE = re.compile(
     r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{([^}]*)\})?\s+(.*)$")
 
@@ -831,6 +868,8 @@ class RouterHandler:
         return -1, results[0]
 
     async def _scatter_list(self, req: Request, target: str) -> Response:
+        if req.param("continue") or req.param("limit"):
+            return await self._scatter_list_paged(req, target)
         results = await self._scatter("GET", target, self._fwd_headers(req))
         for s, h, b in results:
             if s >= 400:
@@ -841,6 +880,103 @@ class RouterHandler:
         if merged is None:
             merged = self._merge_lists_dict(bodies)
         return Response(body=merged)
+
+    async def _scatter_list_paged(self, req: Request, target: str) -> Response:
+        """KEP-365 chunking across the fleet: shards page one at a time,
+        in shard order, each pinned at the RV its first-page scatter
+        answered — the concatenated pages reproduce exactly what the
+        unpaged byte-splice merge serves, because that merge IS the
+        shards' sorted bodies in shard order. The client-facing continue
+        token packs every shard's pinned RV and per-shard store token
+        (:func:`_encode_router_continue`); the page envelope carries the
+        vector RV, so the final page anchors watches exactly like the
+        one-shot merge. A token minted against a different shard count
+        answers typed 410 — re-list, never guess."""
+        n = len(self.ring)
+        cont = req.param("continue")
+        headers = self._fwd_headers(req)
+        if not cont:
+            # first page: the scatter doubles as the RV-pin snapshot —
+            # shard 0's page is served now, every other shard's is
+            # discarded but its pinned RV seeds a from-start token
+            results = await self._scatter("GET", target, headers)
+            for s, h, b in results:
+                if s >= 400:
+                    return self._relay(s, h, b)
+            rvs: list[int] = []
+            parsed: list[tuple[bytes, int]] = []
+            for _s, _h, body in results:
+                i = body.find(_ITEMS_MARKER)
+                m = _RV_RE.search(body[:i]) if i >= 0 else None
+                if i < 0 or m is None or not body.endswith(b"]}"):
+                    # non-standard shape (Table, legacy shard): the
+                    # unpaged dict merge is the honest fallback
+                    return Response(body=self._merge_lists_dict(
+                        [b for _s2, _h2, b in results]))
+                parsed.append((body, i))
+                rvs.append(int(m.group(1)))
+            toks: list[str | None] = []
+            for j, (body, i) in enumerate(parsed):
+                cm = _CONT_RE.search(body[:i])
+                span = body[i + len(_ITEMS_MARKER):-2]
+                if j == 0:
+                    toks.append(cm.group(1).decode() if cm else None)
+                elif cm is None and not span:
+                    toks.append(None)  # provably empty at the pin
+                else:
+                    toks.append(encode_continue(rvs[j], None))
+            return self._paged_response(parsed[0][0], rvs, toks)
+        decoded = _decode_router_continue(cont, n)
+        if decoded is None:
+            REGISTRY.counter("list_continue_410_total",
+                             "continue tokens answered with 410").inc()
+            raise errors.GoneError(
+                "continue token does not match this router's shard "
+                "topology; re-list")
+        rvs, toks = decoded
+        idx = next((j for j, t in enumerate(toks) if t is not None), None)
+        if idx is None:
+            raise errors.GoneError("continue token is exhausted; re-list")
+        status, h, body = await self._call(
+            idx, "GET", _swap_continue(target, toks[idx]), None, headers)
+        if status >= 400:
+            # a shard's own 410 (window expired under the pin) relays:
+            # the client restarts its chunked list from scratch
+            return self._relay(status, h, body)
+        i = body.find(_ITEMS_MARKER)
+        if i < 0 or _RV_RE.search(body[:i]) is None \
+                or not body.endswith(b"]}"):
+            raise errors.GoneError(
+                f"shard {self.ring.shards[idx].name} answered an "
+                "unpageable list body; re-list")
+        cm = _CONT_RE.search(body[:i])
+        toks[idx] = cm.group(1).decode() if cm else None
+        return self._paged_response(body, rvs, toks)
+
+    def _paged_response(self, body: bytes, rvs: list[int],
+                        toks: list) -> Response:
+        """One shard's page body rewritten into the fleet envelope:
+        resourceVersion becomes the vector RV; the shard's own continue
+        (never meaningful to a client) is folded into — or replaced by —
+        the packed router token."""
+        i = body.find(_ITEMS_MARKER)
+        head = body[:i + len(_ITEMS_MARKER)]
+        tail = body[i + len(_ITEMS_MARKER):]
+        router_tok = (_encode_router_continue(rvs, toks)
+                      if any(t is not None for t in toks) else None)
+        m = _RV_RE.search(head)
+        assert m is not None  # caller verified
+        head = (head[:m.start(1)] + str(encode_rvmap(rvs)).encode()
+                + head[m.end(1):])
+        m2 = _CONT_RE.search(head)
+        if m2 is not None and router_tok is not None:
+            head = (head[:m2.start(1)] + router_tok.encode()
+                    + head[m2.end(1):])
+        elif router_tok is not None:
+            ins = _RV_RE.search(head).end()
+            head = (head[:ins] + b', "continue": "' + router_tok.encode()
+                    + b'"' + head[ins:])
+        return Response(body=head + tail)
 
     def _merge_lists(self, bodies: list[bytes]) -> bytes | None:
         """Byte-splice shard list bodies into one: per-object bytes are

@@ -112,6 +112,7 @@ asyncio.Event so async consumers can await new events.
 from __future__ import annotations
 
 import asyncio
+import base64
 import copy
 import json
 import logging
@@ -141,6 +142,37 @@ from .selectors import LabelSelector, everything
 log = logging.getLogger(__name__)
 
 WILDCARD = "*"
+
+# KEP-3157-style watch-list: the sync bookmark that ends the initial
+# ADDED stream carries this annotation set to "true"
+BOOKMARK = "BOOKMARK"
+INITIAL_EVENTS_END = "kcp.io/initial-events-end"
+
+
+def encode_continue(rv: int, last_key: tuple | list | None) -> str:
+    """Opaque KEP-365-style continue token: urlsafe base64 of
+    ``{"rv": N, "k": [cluster, namespace, name] | null}``. ``k=null``
+    means "from the start, pinned at rv" (the router synthesizes these
+    for shards whose first page it discards)."""
+    payload = {"rv": int(rv), "k": list(last_key) if last_key else None}
+    raw = json.dumps(payload, separators=(",", ":")).encode()
+    return base64.urlsafe_b64encode(raw).decode()
+
+
+def decode_continue(token: str) -> tuple[int, tuple | None]:
+    """Inverse of :func:`encode_continue`; raises ValueError on any
+    malformed token (callers answer typed 410 — the client re-lists)."""
+    try:
+        payload = json.loads(base64.urlsafe_b64decode(token.encode()))
+        rv = int(payload["rv"])
+        k = payload.get("k")
+        if k is not None:
+            k = tuple(k)
+            if len(k) != 3 or not all(isinstance(p, str) for p in k):
+                raise ValueError(f"bad continue key {k!r}")
+        return rv, k
+    except (ValueError, KeyError, TypeError) as e:
+        raise ValueError(f"malformed continue token: {e}") from None
 
 
 def _env_indexed() -> bool:
@@ -1285,6 +1317,265 @@ class LogicalStore:
         # read nor stored
         return b", ".join(self.encode_many(
             [obj for _, obj in sorted(ns_b.items())]))
+
+    # ------------------------------------------- paginated (chunked) lists
+
+    def _page_metrics(self) -> None:
+        REGISTRY.counter("list_pages_total",
+                         "list pages served (limit/continue chunking)").inc()
+
+    def _check_continue_window(self, rv_pin: int) -> None:
+        """A continue token is only honorable while the watch window
+        still covers ``(rv_pin, now]`` — the exact bound a watch resume
+        uses, because the RV pin is reconstructed from the same retained
+        history. Outside it: typed 410, the client re-lists."""
+        if rv_pin > self._rv:
+            REGISTRY.counter("list_continue_410_total",
+                             "continue tokens answered with 410").inc()
+            raise GoneError(
+                f"continue token rv {rv_pin} is ahead of this store's "
+                f"rv {self._rv}; re-list")
+        if rv_pin < self._rv:
+            oldest = self._history[0].rv if self._history else None
+            if oldest is None or oldest > rv_pin + 1:
+                REGISTRY.counter("list_continue_410_total",
+                                 "continue tokens answered with 410").inc()
+                raise GoneError(
+                    f"continue token expired: pinned rv {rv_pin}, oldest "
+                    f"retained {oldest}; re-list")
+
+    def _pairs_at_pin(
+        self,
+        resource: str,
+        cluster: str,
+        namespace: str | None,
+        rv_pin: int,
+    ) -> list[tuple[Key, dict]]:
+        """Sorted scoped ``(key, obj)`` pairs exactly as of ``rv_pin``
+        (caller has verified the window covers the gap): start from the
+        live buckets and undo retained events newer than the pin, newest
+        first — ``old_object`` is the CoW snapshot each event displaced,
+        so the rewound objects ARE the objects a list at ``rv_pin``
+        returned, byte-cache and all."""
+        pairs: dict[Key, dict] = {}
+        res_b = self._buckets.get(resource)
+        if res_b:
+            if cluster != WILDCARD:
+                cl_bs = [res_b[cluster]] if cluster in res_b else []
+            else:
+                cl_bs = list(res_b.values())
+            for cl_b in cl_bs:
+                if namespace is not None:
+                    ns_bs = [cl_b[namespace]] if namespace in cl_b else []
+                else:
+                    ns_bs = list(cl_b.values())
+                for ns_b in ns_bs:
+                    pairs.update(ns_b)
+        if rv_pin < self._rv:
+            for ev in reversed(self._resume_slice(rv_pin)):
+                if ev.resource != resource:
+                    continue
+                if cluster != WILDCARD and ev.cluster != cluster:
+                    continue
+                if namespace is not None and ev.namespace != namespace:
+                    continue
+                if ev.type == ADDED:
+                    pairs.pop(ev.key, None)
+                else:  # MODIFIED / DELETED: restore the displaced snapshot
+                    if ev.old_object is not None:
+                        pairs[ev.key] = ev.old_object
+        return sorted(pairs.items())
+
+    def list_page(
+        self,
+        resource: str,
+        cluster: str = WILDCARD,
+        namespace: str | None = None,
+        selector: LabelSelector | None = None,
+        limit: int = 0,
+        continue_token: str | None = None,
+    ) -> tuple[list[dict], int, str]:
+        """KEP-365-style chunked list: ``(items, rv, next_token)``.
+
+        The first page pins the list at the current rv; every
+        continuation serves from the state *as of that pin* (rewound via
+        the retained watch window), so concatenated pages are exactly
+        the one-shot list at the pinned rv no matter what mutated in
+        between. A token the window no longer covers answers typed 410.
+        With a selector, the continue key is the last *matched* item's
+        key — the filtered order is a subsequence of the raw key order,
+        so the resume position is still exact.
+        """
+        _inject("store.list")
+        selector = selector or everything()
+        if (limit <= 0 and not continue_token) or not self._indexed:
+            # no chunking asked for — or the legacy store, which has no
+            # CoW history to pin against: serve the one-shot list (no
+            # continue, so paging clients fall back cleanly)
+            items, rv = self.list(resource, cluster, namespace, selector)
+            return items, rv, ""
+        last_key: tuple | None = None
+        if continue_token:
+            try:
+                rv_pin, last_key = decode_continue(continue_token)
+            except ValueError:
+                REGISTRY.counter("list_continue_410_total",
+                                 "continue tokens answered with 410").inc()
+                raise GoneError("malformed continue token; re-list") \
+                    from None
+            self._check_continue_window(rv_pin)
+        else:
+            self._flush_events()
+            rv_pin = self._rv
+        pairs = self._pairs_at_pin(resource, cluster, namespace, rv_pin)
+        boundary = (resource,) + last_key if last_key is not None else None
+        out: list[dict] = []
+        scanned = 0
+        next_token = ""
+        last_included: Key | None = None
+        empty = selector.empty
+        for key, obj in pairs:
+            if boundary is not None and key <= boundary:
+                continue
+            scanned += 1
+            if not empty:
+                labels = (obj.get("metadata") or {}).get("labels") or {}
+                if not selector.matches(labels):
+                    continue
+            if limit > 0 and len(out) >= limit:
+                next_token = encode_continue(rv_pin, last_included[1:])
+                break
+            out.append(obj)
+            last_included = key
+        self._list_metrics(scanned, len(out))
+        self._page_metrics()
+        return out, rv_pin, next_token
+
+    def list_encoded_page(
+        self,
+        resource: str,
+        cluster: str = WILDCARD,
+        namespace: str | None = None,
+        limit: int = 0,
+        continue_token: str | None = None,
+    ) -> tuple[list[bytes], int, str]:
+        """Encode-once chunked list for *unselected* scopes:
+        ``(spans, rv, next_token)``. The current-rv page walks the
+        sorted buckets and splices whole cached :meth:`_bucket_span`
+        entries for every fully-included bucket, encoding only the
+        boundary slices — a page over unchanged buckets costs list
+        appends, not encodes. Pinned-in-the-past pages rewind through
+        the watch window like :meth:`list_page`; the rewound snapshots
+        still hit the per-object byte cache, so pages stay
+        byte-identical to the one-shot body at the pinned rv."""
+        _inject("store.list")
+        if limit <= 0 and not continue_token:
+            spans, rv = self.list_encoded(resource, cluster, namespace)
+            return spans, rv, ""
+        last_key: tuple | None = None
+        if continue_token:
+            try:
+                rv_pin, last_key = decode_continue(continue_token)
+            except ValueError:
+                REGISTRY.counter("list_continue_410_total",
+                                 "continue tokens answered with 410").inc()
+                raise GoneError("malformed continue token; re-list") \
+                    from None
+            self._check_continue_window(rv_pin)
+        else:
+            self._flush_events()
+            rv_pin = self._rv
+        if rv_pin == self._rv:
+            return self._encoded_page_current(
+                resource, cluster, namespace, limit, last_key, rv_pin)
+        pairs = self._pairs_at_pin(resource, cluster, namespace, rv_pin)
+        if last_key is not None:
+            boundary = (resource,) + last_key
+            pairs = [p for p in pairs if p[0] > boundary]
+        page = pairs[:limit] if limit > 0 else pairs
+        # per-item spans, never a page-wide join: the envelope's parts
+        # join (one allocation, at send) is the only materialization
+        spans = self.encode_many([o for _, o in page]) if page else []
+        next_token = ""
+        if limit > 0 and len(pairs) > limit:
+            k = page[-1][0]
+            next_token = encode_continue(rv_pin, k[1:])
+        self._list_metrics(len(page), len(page))
+        self._page_metrics()
+        return spans, rv_pin, next_token
+
+    def _encoded_page_current(
+        self,
+        resource: str,
+        cluster: str,
+        namespace: str | None,
+        limit: int,
+        last_key: tuple | None,
+        rv_pin: int,
+    ) -> tuple[list[bytes], int, str]:
+        spans: list[bytes] = []
+        scanned = 0
+        returned = 0
+        next_token = ""
+        last_included: tuple | None = None
+        remaining = limit if limit > 0 else None
+        res_b = self._buckets.get(resource)
+        buckets: list[tuple[str, str, dict]] = []
+        if res_b:
+            if cluster != WILDCARD:
+                cl_keys = [cluster] if cluster in res_b else []
+            else:
+                cl_keys = sorted(res_b)
+            for c in cl_keys:
+                cl_b = res_b[c]
+                if namespace is not None:
+                    ns_keys = [namespace] if namespace in cl_b else []
+                else:
+                    ns_keys = sorted(cl_b)
+                for n in ns_keys:
+                    buckets.append((c, n, cl_b[n]))
+        for c, n, ns_b in buckets:
+            if not ns_b:
+                continue
+            if last_key is not None and (c, n) < tuple(last_key[:2]):
+                continue  # bucket wholly before the cursor
+            items = sorted(ns_b.items())
+            whole_bucket = True
+            if last_key is not None and (c, n) == tuple(last_key[:2]):
+                items = [kv for kv in items if kv[0][3] > last_key[2]]
+                whole_bucket = False
+                if not items:
+                    continue
+            if remaining is not None and remaining == 0:
+                # page is full and at least one more item exists
+                next_token = encode_continue(rv_pin, last_included)
+                break
+            scanned += len(ns_b)
+            if remaining is None or len(items) <= remaining:
+                if whole_bucket:
+                    # fully-included untouched bucket: splice its cached
+                    # span — the same bytes the unpaged path serves
+                    spans.append(self._bucket_span((resource, c, n), ns_b))
+                else:
+                    # boundary slice: per-item cached spans, no join —
+                    # the envelope assembles them at send time
+                    spans.extend(self.encode_many([o for _, o in items]))
+                returned += len(items)
+                if remaining is not None:
+                    remaining -= len(items)
+                last_included = (c, n, items[-1][0][3])
+            else:
+                take = items[:remaining]
+                spans.extend(self.encode_many([o for _, o in take]))
+                returned += len(take)
+                remaining = 0
+                last_included = (c, n, take[-1][0][3])
+                # this bucket has more: certainly another page
+                next_token = encode_continue(rv_pin, last_included)
+                break
+        self._list_metrics(scanned, returned)
+        self._page_metrics()
+        return spans, rv_pin, next_token
 
     def encode_event(self, ev: Event) -> bytes:
         """The encoded watch wire line ``{"type": ..., "object": ...}\\n``

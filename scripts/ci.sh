@@ -410,6 +410,47 @@ for s in r["scenarios"]:
 print("scenario smoke ok:", {s["name"]: s["schedule"]["hash"] for s in r["scenarios"]})
 '
 
+echo "== pagination: paged-vs-unpaged relist A/B (bytes identical, bounded peak)"
+# reduced-scale --pagination lane: limit/continue pages through the
+# real handler must concatenate byte-identically (sha256) to the
+# one-shot body at the same RV, and cut peak relist allocation >=4x
+# at 10k objects (the committed full-scale A/B floor is 5x at 100k)
+pag_line=$(KCP_BENCH_PAG_OBJECTS=10000 KCP_BENCH_PAG_PAGE=1000 \
+    python bench.py --pagination | tail -1)
+printf '%s\n' "$pag_line" | python -c '
+import json, sys
+r = json.loads(sys.stdin.readline())
+pb = r["pagination_bench"]
+assert pb["bytes_equal"], "concatenated pages != one-shot body"
+assert pb["rv_equal"], "paged rv pin diverged from one-shot rv"
+assert r["value"] >= 4.0, "peak cut %sx < 4x CI floor" % r["value"]
+print("pagination smoke ok: %d pages | bytes equal | peak cut %.2fx (%d KB -> %d KB)"
+      % (pb["pages"], r["value"], pb["unpaged_peak_kb"], pb["paged_peak_kb"]))
+'
+
+echo "== gauntlet: composed BASELINE-shape smoke (1 config, 1/50th scale)"
+# one gauntlet config end to end at CI scale: the demo-fleet shape (200
+# clusters at 1/50th of the 10k-workspace config, ~2k acked objects)
+# with smart-client writers — floors on zero loss and a real
+# reconciles/sec number, plus the embedded relist A/B staying byte-equal
+gl_line=$(KCP_GAUNTLET_CONFIGS=2 KCP_GAUNTLET_SCALE=50 KCP_GAUNTLET_OPS=10 \
+    KCP_BENCH_PAG_OBJECTS=2000 KCP_BENCH_PAG_PAGE=250 \
+    python bench.py --gauntlet | tail -1)
+printf '%s\n' "$gl_line" | python -c '
+import json, sys
+r = json.loads(sys.stdin.readline())
+rows = r["rows"]
+assert rows, "gauntlet emitted no scorecard rows"
+for row in rows:
+    assert row.get("passed"), (row.get("name"), row.get("slos"), row.get("error"))
+    assert row.get("lost_acked_writes") == 0, row
+    assert (row.get("reconciles_per_sec") or 0) > 20, row
+assert r["relist"]["bytes_equal"], "gauntlet relist A/B bytes diverged"
+print("gauntlet smoke ok: %s | %.0f acked/s | conv p99 %.1fms | rss growth %.3f"
+      % (rows[0]["name"], rows[0]["reconciles_per_sec"],
+         rows[0]["convergence_p99_ms"], rows[0]["memory_growth_ratio"]))
+'
+
 if [[ "$fast" == "0" ]]; then
     echo "== demo: both golden scenarios, checked against committed output"
     python contrib/demo/run_demo.py all --check

@@ -226,7 +226,13 @@ def main(argv: list[str] | None = None) -> int:
     if args.emit_ndjson:
         # transport-oracle output: identical record shape to the fenced
         # source's filtered feed (SNAP -> {"op":"put",...}); stdout is
-        # ONLY records so the stream pipes clean into /migration/ingest
+        # ONLY records so the stream pipes clean into /migration/ingest.
+        # Records are written in bounded batches (mirroring the feed's
+        # 256-line spans): one buffered write per batch instead of one
+        # syscall per record, and never a whole-cluster join — a large
+        # cluster streams at flat memory. Record bytes are unchanged.
+        batch: list[str] = []
+        out = sys.stdout
         for key in sorted(st.objects):
             parts = key.decode("utf-8", "replace").split("\x00")
             try:
@@ -235,8 +241,14 @@ def main(argv: list[str] | None = None) -> int:
                 print(f"skipping non-JSON value at {'/'.join(parts)}",
                       file=sys.stderr)
                 continue
-            print(json.dumps({"op": "put", "key": parts, "obj": obj},
-                             separators=(",", ":")))
+            batch.append(json.dumps({"op": "put", "key": parts, "obj": obj},
+                                    separators=(",", ":")) + "\n")
+            if len(batch) >= 256:
+                out.write("".join(batch))
+                batch = []
+        if batch:
+            out.write("".join(batch))
+        out.flush()
         return 0
     summary = {
         "wal": path,

@@ -369,6 +369,38 @@ def test_walreplay_cluster_ndjson_matches_live_feed(tmp_path):
         dst.close()
 
 
+def test_walreplay_oracle_holds_across_feed_batches(tmp_path):
+    """The paged transport drill: a cluster LARGER than one feed batch
+    (>256 objects — the hub streams SNAP lines in 256-line spans and
+    fetches objects per batch rather than materializing a pair list;
+    walreplay buffers stdout in the same 256-record batches). The
+    byte-set oracle must hold exactly as it does for small clusters."""
+    mover = _movers(2, 3)[0]
+    n = 300
+    with shard_fleet(2, durable=True, root_dir=str(tmp_path)) as (
+            router, shards, _ring):
+        c = RestClient(router.address, mover)
+        for i in range(n):
+            c.create("configmaps", _cm(f"big{i:04d}", mover,
+                                       {"i": str(i), "pad": "x" * 64}))
+        c.close()
+        src = next(t for t in shards
+                   if t.server.config.shard_name
+                   == owner_name(["s0", "s1"], mover))
+        live, barrier = migrate.fetch_cluster_records(src.address, mover)
+        assert barrier > 0 and len(live) == n
+        out = subprocess.run(
+            [sys.executable, str(REPO / "scripts" / "walreplay.py"),
+             src.server.config.root_dir, "--cluster", mover,
+             "--emit-ndjson"],
+            capture_output=True, text=True, timeout=120, check=True)
+        offline = [json.loads(line) for line in out.stdout.splitlines()
+                   if line.strip()]
+        key = lambda r: tuple(r["key"])  # noqa: E731
+        assert sorted(offline, key=key) == sorted(live, key=key)
+        assert len(offline) == n
+
+
 # ------------------------------------------- tentpole differential fuzz
 
 

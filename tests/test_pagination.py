@@ -1,0 +1,312 @@
+"""Paginated (KEP-365-style limit/continue) lists: differential fuzz.
+
+The chunked list's contract is byte-level: concatenating the pages'
+``items`` bytes must be IDENTICAL to the one-shot list body at the same
+RV — across selectors, scopes (wildcard / cluster / namespace), the
+encode-once and legacy dict paths, and the router's per-shard paged
+merge. The RV pin is the hard half: mutations landing between pages
+must not leak into later pages (they are served from the watch-window
+rewind at the pinned RV), and a token the window no longer covers
+answers a typed 410, never a silently wrong page.
+
+Also covers: malformed tokens (410), the transparent client-side page
+iteration (KCP_LIST_PAGE), and the KEP-3157-style watch-list informer
+start (initial ADDED stream ending in a sync BOOKMARK on one stream).
+"""
+
+import asyncio
+import hashlib
+import json
+import random
+from urllib.parse import quote
+
+import pytest
+
+from helpers import shard_fleet, wait_until
+from kcp_tpu.apis.scheme import default_scheme
+from kcp_tpu.client import Informer
+from kcp_tpu.server import Config, RestClient
+from kcp_tpu.server.handler import RestHandler
+from kcp_tpu.server.httpd import Request
+from kcp_tpu.server.threaded import ServerThread
+from kcp_tpu.store.store import LogicalStore, encode_continue
+from kcp_tpu.utils import errors
+
+_MARKER = b'"items": ['
+
+
+def _cm(name, ns, cluster, v, labels=None):
+    meta = {"name": name, "namespace": ns, "uid": f"uid-{cluster}-{ns}-{name}"}
+    if labels:
+        meta["labels"] = dict(labels)
+    return {"apiVersion": "v1", "kind": "ConfigMap", "metadata": meta,
+            "data": {"v": str(v)}}
+
+
+def _stack(encode_cache=True, seed_objects=37):
+    store = LogicalStore(indexed=True, encode_cache=encode_cache,
+                        clock=lambda: 1_700_000_000.0)
+    handler = RestHandler(store, default_scheme(), admission=None)
+    rng = random.Random(11)
+    for i in range(seed_objects):
+        c = f"c{i % 3}"
+        ns = f"ns{i % 2}"
+        labels = rng.choice([None, {"team": "a"}, {"team": "b"}])
+        store.create("configmaps", c, _cm(f"n{i:03d}", ns, c, i, labels), ns)
+    return store, handler
+
+
+async def _get(handler, path, query):
+    resp = await handler(Request("GET", path, query, {}, b""))
+    return resp.status, resp.body
+
+
+def _items_span(body: bytes) -> bytes:
+    i = body.find(_MARKER)
+    assert i >= 0 and body.endswith(b"]}"), body[:120]
+    return body[i + len(_MARKER):-2]
+
+
+def _meta(body: bytes) -> dict:
+    return json.loads(body).get("metadata") or {}
+
+
+async def _paged_spans(handler, path, base_query, limit):
+    """All pages' items spans + the first page's envelope RV."""
+    spans, rv, cont = [], None, None
+    for _ in range(1000):
+        q = dict(base_query)
+        q["limit"] = [str(limit)]
+        if cont:
+            q["continue"] = [cont]
+        status, body = await _get(handler, path, q)
+        assert status == 200, body
+        span = _items_span(body)
+        if span:
+            spans.append(span)
+        meta = _meta(body)
+        if rv is None:
+            rv = meta.get("resourceVersion")
+        cont = meta.get("continue")
+        if not cont:
+            return spans, rv
+    raise AssertionError("pagination never terminated")
+
+
+@pytest.mark.parametrize("encode_cache", [True, False])
+def test_paged_pages_concatenate_to_one_shot_body(encode_cache):
+    async def run():
+        _store, handler = _stack(encode_cache)
+        scopes = [
+            ("/clusters/*/api/v1/configmaps", {}),
+            ("/clusters/c1/api/v1/configmaps", {}),
+            ("/clusters/c0/api/v1/namespaces/ns0/configmaps", {}),
+            ("/clusters/*/api/v1/configmaps",
+             {"labelSelector": ["team=a"]}),
+            ("/clusters/c2/api/v1/configmaps",
+             {"labelSelector": ["team=b"]}),
+        ]
+        for path, base_q in scopes:
+            status, one_shot = await _get(handler, path, dict(base_q))
+            assert status == 200
+            whole = _items_span(one_shot)
+            rv0 = _meta(one_shot)["resourceVersion"]
+            for limit in (1, 3, 7, 10_000):
+                spans, rv = await _paged_spans(handler, path, base_q, limit)
+                assert rv == rv0, (path, limit)
+                joined = b", ".join(spans)
+                assert hashlib.sha256(joined).hexdigest() == \
+                    hashlib.sha256(whole).hexdigest(), (path, limit)
+    asyncio.run(run())
+
+
+def test_mutation_between_pages_serves_the_pinned_rv():
+    async def run():
+        store, handler = _stack()
+        path = "/clusters/*/api/v1/configmaps"
+        _status, snapshot = await _get(handler, path, {})
+        pinned_span = _items_span(snapshot)
+        pinned_rv = _meta(snapshot)["resourceVersion"]
+        # page 1 pins the RV...
+        status, body = await _get(handler, path, {"limit": ["5"]})
+        assert status == 200
+        spans = [_items_span(body)]
+        cont = _meta(body)["continue"]
+        assert _meta(body)["resourceVersion"] == pinned_rv
+        # ...then the world churns: creates before AND after the cursor,
+        # updates and deletes in both the served and unserved regions
+        store.create("configmaps", "c0",
+                     _cm("a-before-cursor", "ns0", "c0", "new"), "ns0")
+        store.create("configmaps", "c2",
+                     _cm("zz-after-cursor", "ns1", "c2", "new"), "ns1")
+        for i in (1, 20, 33):
+            c, ns, name = f"c{i % 3}", f"ns{i % 2}", f"n{i:03d}"
+            obj = store.get("configmaps", c, name, ns)
+            obj["data"]["v"] = "mutated"
+            store.update("configmaps", c, obj, ns)
+        store.delete("configmaps", "c0", "n030", "ns0")
+        store.delete("configmaps", "c2", "n035", "ns1")
+        # remaining pages still serve the pinned state, byte-identical
+        while cont:
+            status, body = await _get(
+                handler, path, {"limit": ["5"], "continue": [cont]})
+            assert status == 200, body
+            assert _meta(body)["resourceVersion"] == pinned_rv
+            span = _items_span(body)
+            if span:
+                spans.append(span)
+            cont = _meta(body).get("continue")
+        assert b", ".join(spans) == pinned_span
+        # and a fresh unpaged list sees the churned world, not the pin
+        _s, fresh = await _get(handler, path, {})
+        assert _items_span(fresh) != pinned_span
+    asyncio.run(run())
+
+
+def test_continue_token_across_compaction_answers_410():
+    async def run():
+        store, handler = _stack()
+        path = "/clusters/*/api/v1/configmaps"
+        _status, body = await _get(handler, path, {"limit": ["5"]})
+        cont = _meta(body)["continue"]
+        # churn + compaction: the watch window no longer reaches the pin
+        for i in range(5):
+            store.create("configmaps", "c0",
+                         _cm(f"churn{i}", "ns0", "c0", i), "ns0")
+        store._history.clear()
+        status, body = await _get(
+            handler, path, {"limit": ["5"], "continue": [cont]})
+        assert status == 410, body
+        assert json.loads(body).get("reason") in ("Expired", "Gone")
+    asyncio.run(run())
+
+
+def test_malformed_continue_token_answers_410():
+    async def run():
+        _store, handler = _stack()
+        for bad in ("not-base64!", "aGVsbG8=", ""):
+            status, body = await _get(
+                handler, "/clusters/*/api/v1/configmaps",
+                {"limit": ["5"], "continue": [bad]} if bad
+                else {"limit": ["-3"]})
+            assert status in (400, 410), (bad, body)
+    asyncio.run(run())
+
+
+def test_store_list_page_selector_and_future_rv():
+    store, _handler = _stack()
+    from kcp_tpu.store.selectors import parse_selector
+    sel = parse_selector("team=a")
+    got, rv, cont = [], None, None
+    while True:
+        items, rv, cont = store.list_page(
+            "configmaps", selector=sel, limit=2, continue_token=cont)
+        got.extend(items)
+        if not cont:
+            break
+    one_shot, _rv = store.list("configmaps", selector=sel)
+    assert [o["metadata"]["uid"] for o in got] == \
+        [o["metadata"]["uid"] for o in one_shot]
+    # a token minted "from the future" (another shard's counter) is 410
+    with pytest.raises(errors.GoneError):
+        store.list_page("configmaps", limit=2,
+                        continue_token=encode_continue(rv + 10_000, None))
+
+
+def test_router_merged_pages_concatenate_to_one_shot_merge():
+    with shard_fleet(3) as (router, _shards, _ring):
+        seed = RestClient(router.address, cluster="*")
+        raw = RestClient(router.address, cluster="*")
+        for i in range(23):
+            c, ns = f"w{i % 5}", f"ns{i % 2}"
+            obj = _cm(f"n{i:03d}", ns, c, i)
+            obj["metadata"]["clusterName"] = c
+            seed.create("configmaps", obj, ns)
+        target = "/clusters/*/api/v1/configmaps"
+        status, _h, one_shot = raw.request_raw("GET", target)
+        assert status == 200
+        whole = _items_span(one_shot)
+        rv0 = _meta(one_shot)["resourceVersion"]
+        for limit in (1, 4, 50):
+            spans, cont, rv = [], None, None
+            for _ in range(200):
+                t = f"{target}?limit={limit}"
+                if cont:
+                    t += "&continue=" + quote(cont, safe="")
+                status, _h, body = raw.request_raw("GET", t)
+                assert status == 200, body
+                meta = _meta(body)
+                if rv is None:
+                    rv = meta["resourceVersion"]
+                span = _items_span(body)
+                if span:
+                    spans.append(span)
+                cont = meta.get("continue")
+                if not cont:
+                    break
+            assert cont is None or cont == ""
+            assert rv == rv0, limit
+            assert b", ".join(spans) == whole, limit
+        # a stale/malformed router token answers 410 (re-list)
+        status, _h, body = raw.request_raw(
+            "GET", f"{target}?limit=5&continue=bogus-token")
+        assert status == 410, body
+
+
+def test_rest_client_pages_transparently(monkeypatch):
+    with ServerThread(Config(durable=False, tls=False,
+                             install_controllers=False)) as srv:
+        c = RestClient(srv.address, cluster="t")
+        for i in range(17):
+            c.create("configmaps", _cm(f"n{i:03d}", "d", "t", i), "d")
+        monkeypatch.setenv("KCP_LIST_PAGE", "0")
+        unpaged, rv_u = c.list("configmaps", "d")
+        monkeypatch.setenv("KCP_LIST_PAGE", "4")
+        paged, rv_p = c.list("configmaps", "d")
+        assert [o["metadata"]["uid"] for o in paged] == \
+            [o["metadata"]["uid"] for o in unpaged]
+        assert rv_p == rv_u
+        # explicit limit overrides the env default
+        two_pages, _rv = c.list("configmaps", "d", limit=9)
+        assert len(two_pages) == 17
+
+
+def test_informer_watch_list_start_and_live_tail():
+    async def run():
+        with ServerThread(Config(durable=False, tls=False,
+                                 install_controllers=False)) as srv:
+            c = RestClient(srv.address, cluster="t")
+            for i in range(9):
+                c.create("configmaps", _cm(f"n{i}", "d", "t", i), "d")
+            inf = Informer(c, "configmaps", watch_list=True)
+            await inf.start()
+            try:
+                assert inf.synced
+                assert len(inf.list()) == 9
+                from kcp_tpu.utils.trace import REGISTRY
+                assert REGISTRY.counter(
+                    "informer_watch_list_starts_total").value >= 1
+                # the same stream carries the live tail
+                c.create("configmaps", _cm("late", "d", "t", 99), "d")
+                assert await wait_until(
+                    lambda: inf.get("t", "late", "d") is not None, 10.0)
+            finally:
+                await inf.stop()
+    asyncio.run(run())
+
+
+def test_informer_watch_list_falls_back_without_support():
+    async def run():
+        store = LogicalStore(indexed=True)
+        from kcp_tpu.client import Client
+        client = Client(store, "c0")
+        store.create("configmaps", "c0", _cm("x", "d", "c0", 1), "d")
+        # in-process Client doesn't advertise watch-list: classic path
+        inf = Informer(client, "configmaps", watch_list=True)
+        await inf.start()
+        try:
+            assert not inf._watch_list
+            assert len(inf.list()) == 1
+        finally:
+            await inf.stop()
+    asyncio.run(run())
