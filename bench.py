@@ -1258,6 +1258,97 @@ def store_bench() -> int:
     return 0
 
 
+def placement_bench() -> int:
+    """Fleet bin-pack A/B (``--placement``): BASELINE configs[2] — the
+    deployment-splitter replica bin-pack at 10k workspaces x 8 pclusters
+    with lognormal-skewed capacity — solved as ONE device batch
+    (fleet/solver.solve_batched via FleetSolver) vs the pre-fleet
+    splitter's per-workspace host loop (one solve per root Deployment).
+    Rows are independent, so both must produce the byte-identical
+    assignment the numpy host twin gives; the speedup is pure batching.
+    One JSON line; the batched-vs-loop throughput ratio is the value.
+    """
+    from kcp_tpu.fleet.solver import FleetSolver, solve_host
+
+    W = int(os.environ.get("KCP_BENCH_PLACEMENT_WORKSPACES", "10000"))
+    P = int(os.environ.get("KCP_BENCH_PLACEMENT_PCLUSTERS", "8"))
+    spread = int(os.environ.get("KCP_BENCH_PLACEMENT_SPREAD", "2"))
+    iters = int(os.environ.get("KCP_BENCH_PLACEMENT_ITERS", "5"))
+    # the loop lane may sample (then extrapolate): at full scale it IS
+    # the slow side, and CI smoke shouldn't pay 10k python solves twice
+    loop_rows = min(
+        int(os.environ.get("KCP_BENCH_PLACEMENT_LOOP_ROWS", "0")) or W, W)
+    dirty = int(os.environ.get("KCP_BENCH_PLACEMENT_DIRTY_ROWS", "37"))
+
+    rng = np.random.default_rng(17)
+    demand = rng.integers(0, 48, W).astype(np.int32)
+    alloc = np.clip(rng.lognormal(3.0, 1.2, P), 1, 30000).astype(np.int32)
+    cand = rng.random((W, P)) < 0.9
+    region = rng.integers(0, 4, P).astype(np.int32)
+    home = rng.integers(-1, 4, W).astype(np.int32)
+
+    solver = FleetSolver(spread=spread)
+    solver.solve(demand, cand, alloc, region, home)  # compile warm-up
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        dev = solver.solve(demand, cand, alloc, region, home)
+    batched_s = (time.perf_counter() - t0) / iters
+    # solve() returns the solver's live cache — snapshot it before the
+    # incremental lane below scatters the dirty-row delta into it
+    dev = dev.copy()
+
+    host = solve_host(demand, cand, alloc, region, home, spread)
+
+    # the pre-fleet splitter re-solved each workspace on its own: one
+    # host solve per row, W dispatches per fleet pass
+    per = np.zeros_like(host)
+    t0 = time.perf_counter()
+    for i in range(loop_rows):
+        per[i] = solve_host(demand[i:i + 1], cand[i:i + 1], alloc, region,
+                            home[i:i + 1], spread)[0]
+    loop_sample_s = time.perf_counter() - t0
+    loop_s = loop_sample_s * (W / max(loop_rows, 1))
+
+    # incremental re-solve: a dirty candidate delta must touch exactly
+    # those rows and still match a from-scratch host recompute
+    idx = rng.choice(W, size=min(dirty, W), replace=False)
+    cand2 = cand.copy()
+    cand2[idx] = rng.random((idx.size, P)) < 0.7
+    before = solver.stats["rows_solved"]
+    dev2 = solver.solve(demand, cand2, alloc, region, home,
+                        rows=[int(i) for i in idx])
+    inc_rows = solver.stats["rows_solved"] - before
+    host2 = solve_host(demand, cand2, alloc, region, home, spread)
+
+    speedup = loop_s / max(batched_s, 1e-9)
+    out = {
+        "metric": "placement_batched_speedup",
+        "value": round(speedup, 2),
+        "unit": "x",
+        "placement_bench": {
+            "workspaces": W, "pclusters": P, "spread": spread,
+            "iters": iters, "loop_rows_sampled": loop_rows,
+            "batched_ms": round(batched_s * 1e3, 3),
+            "per_workspace_ms": round(loop_s * 1e3, 3),
+            "batched_rows_per_s": int(W / max(batched_s, 1e-9)),
+            "per_workspace_rows_per_s": int(W / max(loop_s, 1e-9)),
+            "assignment_equal_host": bool((dev == host).all()),
+            "assignment_equal_per_workspace": bool(
+                (per[:loop_rows] == host[:loop_rows]).all()),
+            "total_replicas": int(host.sum()),
+            "overcommit_rows": int((dev.sum(axis=1) > demand).sum()),
+            "noncandidate_replicas": int(dev[~cand].sum()),
+            "incremental": {
+                "dirty_rows": int(idx.size),
+                "rows_solved": int(inc_rows),
+                "mismatches": int((dev2 != host2).any(axis=1).sum()),
+            },
+        },
+    }
+    emit(out)
+    return 0
+
+
 def encode_bench() -> int:
     """Encode-once serving A/B (``--encode``): list-encode and
     watch-fan-out-encode through the real RestHandler at the BASELINE
@@ -4525,7 +4616,7 @@ if __name__ == "__main__":
             or "--watchers" in args or "--trace" in args
             or "--smartclient" in args or "--writes" in args
             or "--elastic" in args or "--pagination" in args
-            or "--gauntlet" in args):
+            or "--gauntlet" in args or "--placement" in args):
         # pure-host microbenches: pin CPU (never touch the tunnel)
         # and run in-process — no watchdog child needed
         try:
@@ -4545,6 +4636,7 @@ if __name__ == "__main__":
                  else writes_bench() if "--writes" in args
                  else pagination_bench() if "--pagination" in args
                  else gauntlet_bench() if "--gauntlet" in args
+                 else placement_bench() if "--placement" in args
                  else encode_bench())
     if "--probe" in args:
         # manual diagnostic: always run in-process (never through the

@@ -19,7 +19,8 @@ import re
 
 from .base import Finding, RepoChecker, SourceFile
 
-FAULT_CALLS = frozenset({"maybe_fail", "should_drop", "_inject"})
+FAULT_CALLS = frozenset({"maybe_fail", "should_drop", "_inject",
+                         "link_cut", "link_delay"})
 
 
 def _declared_points(files: list[SourceFile]

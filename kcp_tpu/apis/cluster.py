@@ -50,6 +50,43 @@ def is_ready(cluster: dict) -> bool:
     return is_condition_true(cluster, READY)
 
 
+# Fleet placement surface (kcp_tpu/fleet/): provisioned capacity lives
+# in spec, the health-adjusted allocatable in status, WAN locality in
+# labels — mirroring node capacity/allocatable + topology labels upstream.
+CAPACITY_KEY = "replicas"
+REGION_LABEL = "fleet.kcp.dev/region"
+
+
+def set_capacity(cluster: dict, replicas: int,
+                 allocatable: int | None = None,
+                 region: str = "") -> None:
+    cluster.setdefault("spec", {})["capacity"] = {CAPACITY_KEY: int(replicas)}
+    cluster.setdefault("status", {})["allocatable"] = {
+        CAPACITY_KEY: int(replicas if allocatable is None else allocatable)}
+    if region:
+        cluster.setdefault("metadata", {}).setdefault(
+            "labels", {})[REGION_LABEL] = region
+
+
+def capacity_of(cluster: dict) -> int:
+    """Provisioned replica capacity (0 = unspecified/unlimited-legacy)."""
+    return int(((cluster.get("spec") or {}).get("capacity") or {})
+               .get(CAPACITY_KEY, 0) or 0)
+
+
+def allocatable_of(cluster: dict) -> int:
+    """Health-adjusted allocatable replicas; falls back to capacity."""
+    alloc = ((cluster.get("status") or {}).get("allocatable") or {})
+    if CAPACITY_KEY in alloc:
+        return int(alloc[CAPACITY_KEY] or 0)
+    return capacity_of(cluster)
+
+
+def region_of(cluster: dict) -> str:
+    return ((cluster.get("metadata") or {}).get("labels") or {}).get(
+        REGION_LABEL, "")
+
+
 def synced_resources(cluster: dict) -> list[str]:
     return (cluster.get("status") or {}).get("syncedResources") or []
 

@@ -14,9 +14,10 @@ Spec grammar — semicolon-separated clauses, each ``point:action``::
 device.step:raise@tick=57;syncer.apply:latency=50ms"
     KCP_FAULTS_SEED=1337
 
-    clause  := <point> ":" <action> [ "=" <value> ] [ "@tick=" <n> ]
+    clause  := <point> ":" <action> [ "=" <value> ] { "@" <mod> "=" <mval> }
     action  := error | raise | drop | latency | poison_row
     value   := probability (0.05) | duration (50ms, 2s) | row index
+    mod     := tick | peer | heal | jitter
 
 - ``error``      raise :class:`~kcp_tpu.utils.errors.UnavailableError`
                  (an injected 503 — exercises retry/backoff/circuit paths)
@@ -32,6 +33,21 @@ device.step:raise@tick=57;syncer.apply:latency=50ms"
 without it, ``value`` is a per-invocation probability (``error``/``drop``)
 or always-on (``latency``/``poison_row``; ``raise`` with no value fires
 every time).
+
+WAN-link modifiers (the ``link.*`` points)::
+
+    link.partition:drop@peer=*>10.0.0.2:6443@heal=40
+    link.delay:latency=80ms@peer=repl.feed>replica@jitter=20ms
+
+- ``@peer=SRC>DST`` scopes the rule to one *directed* link (``SRC<>DST``
+  matches both directions; either side may be ``*``). A peer-scoped rule
+  only fires at link-aware sites (:func:`link_fault`), so an asymmetric
+  partition — A cannot reach B while B still reaches A — is one clause.
+- ``@heal=N`` heals the rule at the point's Nth invocation: it fires on
+  invocations 1..N-1 and never again (the heal-at-tick lever; the
+  scenario engine's phase-end injector clear is the other heal path).
+- ``@jitter=D`` adds a seeded uniform extra delay in [0, D] on top of a
+  ``latency`` value — WAN jitter, reproducible per seed.
 
 Injection points wired in this codebase:
 
@@ -90,6 +106,22 @@ Injection points wired in this codebase:
                                  and the source fence rolls back so the
                                  cluster keeps serving from its old
                                  owner, latency = a slow cutover)
+    link.partition               peer-pair link cut (``drop`` +
+                                 ``@peer``): every link-aware transport
+                                 — RestClient requests, RestWatch
+                                 streams, the replication feed, the
+                                 applier's probe/ack/fence calls —
+                                 raises ConnectionError while the
+                                 directed pair is cut
+    link.delay                   peer-pair WAN latency (``latency`` +
+                                 ``@peer`` [+ ``@jitter``]) at the same
+                                 link-aware sites; sync sites sleep,
+                                 async sites await
+    fleet.solve                  fleet/solver.py device bin-pack entry
+                                 (error = the solve fails and the
+                                 scheduler retries with its last good
+                                 assignment intact, latency = a slow
+                                 solve tick)
 
 Sites call the module-level helpers, which are near-free no-ops when no
 injector is active (one global read).
@@ -141,6 +173,9 @@ POINTS = frozenset({
     "server.drain",
     "scenario.phase",
     "migrate.cutover",
+    "link.partition",
+    "link.delay",
+    "fleet.solve",
 })
 
 
@@ -154,7 +189,28 @@ class FaultRule:
     action: str
     value: float | None = None
     at_tick: int | None = None
+    # link-scoped modifiers (``@peer=SRC>DST`` / ``@heal=N`` / ``@jitter=D``)
+    peer: tuple[str, str, bool] | None = None  # (src, dst, bidirectional)
+    heal: int | None = None
+    jitter: float | None = None
     fired: int = 0
+
+    def matches_peer(self, peer: tuple[str, str] | None) -> bool:
+        """Does this rule apply to the (src, dst) directed pair? Rules
+        without ``@peer`` fire everywhere; peer-scoped rules only fire at
+        link-aware sites that supply the pair."""
+        if self.peer is None:
+            return True
+        if peer is None:
+            return False
+
+        def one_way(src_pat: str, dst_pat: str) -> bool:
+            return (src_pat in ("*", peer[0])
+                    and dst_pat in ("*", peer[1]))
+
+        src_pat, dst_pat, bidir = self.peer
+        return one_way(src_pat, dst_pat) or (
+            bidir and one_way(dst_pat, src_pat))
 
 
 def _parse_value(raw: str) -> float:
@@ -174,20 +230,42 @@ def parse_spec(spec: str) -> list[FaultRule]:
         point, _, rest = clause.partition(":")
         if not rest:
             raise ValueError(f"fault clause {clause!r} needs '<point>:<action>'")
+        rest, *mods = rest.split("@")
         at_tick: int | None = None
-        if "@" in rest:
-            rest, _, mod = rest.partition("@")
+        heal: int | None = None
+        jitter: float | None = None
+        peer: tuple[str, str, bool] | None = None
+        for mod in mods:
             mkey, _, mval = mod.partition("=")
-            if mkey != "tick":
+            if mkey == "tick":
+                at_tick = int(mval)
+            elif mkey == "heal":
+                heal = int(mval)
+            elif mkey == "jitter":
+                jitter = _parse_value(mval)
+            elif mkey == "peer":
+                bidir = "<>" in mval
+                src, _, dst = (mval.partition("<>") if bidir
+                               else mval.partition(">"))
+                if not src or not dst:
+                    raise ValueError(
+                        f"bad @peer={mval!r} in {clause!r}: want SRC>DST "
+                        f"(directed) or SRC<>DST (both ways); '*' wildcards")
+                peer = (src.strip(), dst.strip(), bidir)
+            else:
                 raise ValueError(f"unknown fault modifier {mod!r} in {clause!r}")
-            at_tick = int(mval)
         action, _, raw = rest.partition("=")
         if action not in ACTIONS:
             raise ValueError(
                 f"unknown fault action {action!r} in {clause!r} "
                 f"(one of {', '.join(ACTIONS)})")
         value = _parse_value(raw) if raw else None
-        rules.append(FaultRule(point.strip(), action, value, at_tick))
+        if jitter is not None and action != "latency":
+            raise ValueError(
+                f"@jitter only modifies latency rules, not {action!r} "
+                f"in {clause!r}")
+        rules.append(FaultRule(point.strip(), action, value, at_tick,
+                               peer=peer, heal=heal, jitter=jitter))
     return rules
 
 
@@ -220,32 +298,43 @@ class FaultInjector:
 
     # ------------------------------------------------------------ firing
 
-    def _advance(self, point: str, rows=None) -> list[FaultRule]:
+    def _advance(self, point: str, rows=None,
+                 peer: tuple[str, str] | None = None
+                 ) -> list[tuple[FaultRule, float]]:
+        """Advance ``point``'s schedule; returns the fired (rule, delay)
+        pairs. ``delay`` is the rule's latency value plus its seeded
+        jitter sample (0.0 for non-latency actions)."""
         st = self._points.get(point)
         if st is None:
             return []
         with self._lock:
             st.count += 1
-            fired: list[FaultRule] = []
+            fired: list[tuple[FaultRule, float]] = []
             for r in st.rules:
+                if not r.matches_peer(peer):
+                    continue
+                if r.heal is not None and st.count >= r.heal:
+                    continue  # healed: fires on invocations 1..heal-1
                 if r.action == "poison_row":
                     if (rows is not None and r.value is not None
                             and int(r.value) in rows):
-                        fired.append(r)
+                        fired.append((r, 0.0))
                     continue
                 if r.at_tick is not None:
                     if st.count == r.at_tick:
-                        fired.append(r)
+                        fired.append((r, 0.0))
                     continue
                 if r.action == "latency":
-                    fired.append(r)
+                    delay = (r.value or 0.0) + (
+                        st.rng.uniform(0.0, r.jitter) if r.jitter else 0.0)
+                    fired.append((r, delay))
                     continue
                 p = 1.0 if r.value is None else r.value
                 if st.rng.random() < p:
-                    fired.append(r)
-            for r in fired:
+                    fired.append((r, 0.0))
+            for r, _ in fired:
                 r.fired += 1
-        for r in fired:
+        for r, _ in fired:
             REGISTRY.counter(
                 "fault_injected_total",
                 "faults fired by the KCP_FAULTS injector").inc()
@@ -261,9 +350,9 @@ class FaultInjector:
         ``raise`` / matching ``poison_row`` rule fires; returns the summed
         ``latency`` delay in seconds otherwise (0.0 when quiet)."""
         delay = 0.0
-        for r in self._advance(point, rows):
+        for r, d in self._advance(point, rows):
             if r.action == "latency":
-                delay += r.value or 0.0
+                delay += d
             elif r.action == "error":
                 raise UnavailableError(f"injected fault: {point}:error")
             elif r.action == "raise":
@@ -275,7 +364,22 @@ class FaultInjector:
 
     def should_drop(self, point: str) -> bool:
         """Advance ``point``'s schedule; True if a ``drop`` rule fired."""
-        return any(r.action == "drop" for r in self._advance(point))
+        return any(r.action == "drop" for r, _ in self._advance(point))
+
+    # ----------------------------------------------------- link realism
+
+    def link_cut(self, point: str, src: str, dst: str) -> bool:
+        """Advance a link point for the (src, dst) directed pair; True if
+        a (possibly peer-scoped) ``drop`` rule cut the link."""
+        return any(r.action == "drop"
+                   for r, _ in self._advance(point, peer=(src, dst)))
+
+    def link_delay(self, point: str, src: str, dst: str) -> float:
+        """Summed latency+jitter seconds injected on the directed pair
+        (0.0 when quiet). The caller sleeps — sync sites ``time.sleep``,
+        async sites ``await asyncio.sleep``."""
+        return sum(d for r, d in self._advance(point, peer=(src, dst))
+                   if r.action == "latency")
 
     def snapshot(self) -> dict[str, int]:
         """point -> invocation count (replay/debugging aid)."""
@@ -323,3 +427,18 @@ def maybe_fail(point: str, rows=None) -> float:
 def should_drop(point: str) -> bool:
     inj = _ACTIVE if _ENV_CHECKED else active()
     return inj.should_drop(point) if inj is not None else False
+
+
+def link_fault(src: str, dst: str) -> float:
+    """One call per transport attempt on a directed link: raises
+    :class:`ConnectionError` while an active ``link.partition`` rule cuts
+    (src, dst); otherwise returns the ``link.delay`` seconds the caller
+    must sleep (0.0 when no injector is active). ``dst`` is conventionally
+    the target's ``host:port``; feed-side sources use stable role names
+    (``repl.feed`` → subscriber role) so specs stay port-free."""
+    inj = _ACTIVE if _ENV_CHECKED else active()
+    if inj is None:
+        return 0.0
+    if inj.link_cut("link.partition", src, dst):
+        raise ConnectionError(f"injected link partition: {src}>{dst}")
+    return inj.link_delay("link.delay", src, dst)

@@ -1,0 +1,211 @@
+"""Device-batched fleet bin-pack: one vmapped program for every workload.
+
+The placement question at fleet scale is [W x P]: W workloads (one row
+per root Deployment), P physical clusters. Per row the solver scores the
+candidate clusters, selects the top-k (spread constraint), and deals the
+replicas proportionally to allocatable capacity — all as ONE jitted
+program (`solve_batched`), so a 10k-workspace re-solve is a single device
+dispatch instead of 10k host loops.
+
+Determinism is the whole contract: the score is integer, ties break on
+column id (stable argsort of the negated score), and the weighted deal is
+integer floor-division with the remainder going to the best-ranked
+clusters (`ops.placement.split_replicas_weighted`). `solve_host` is the
+numpy twin built from the SAME ops — the differential fuzz in
+tests/test_fleet.py proves byte-identical assignments, and the CI
+placement smoke re-proves it on every run.
+
+Overflow bounds (int32, x64 disabled): weights clip at 2^15-1 and demand
+at 2^16-1 so `demand * weight` stays below 2^31.
+
+`FleetSolver` adds the incremental layer: a [W, P] assignment cache where
+a re-solve gathers only the rows whose candidate set changed (the
+inventory's delta), runs the padded device program over that subset, and
+scatters the results back — plus an optional mesh from parallel/mesh.py
+to shard the row dimension of full solves across devices.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..faults import maybe_fail
+from ..ops.encode import pad_pow2
+from ..ops.placement import split_replicas_weighted
+from ..utils.trace import REGISTRY
+
+CAP_CLIP = 32767      # weight clip: demand * weight < 2^31 (int32, x64 off)
+DEMAND_CLIP = 65535
+DEFAULT_LOCALITY_WEIGHT = 1024  # outweighs any capacity delta < 2^10
+_INT32_MAX = 2**31 - 1
+
+
+def _select_row(demand, cand, alloc, region, home_region, spread,
+                locality_weight):
+    """Score + top-k selection for ONE workload row (vmapped over [W]).
+
+    score = locality_weight * in-home-region + min(alloc, CAP_CLIP);
+    eligibility = candidate with positive allocatable. The rank is the
+    stable argsort-of-argsort: rank r means "r clusters score strictly
+    better or tie with a lower column id" — so selected rows occupy ranks
+    0..k-1 exactly, which split_replicas_weighted relies on.
+    """
+    elig = cand & (alloc > 0)
+    w = jnp.minimum(alloc, CAP_CLIP).astype(jnp.int32)
+    score = jnp.where(region == home_region, locality_weight, 0) + w
+    neg = jnp.where(elig, -score, _INT32_MAX).astype(jnp.int32)
+    order = jnp.argsort(neg, stable=True)          # score desc, col asc
+    rank = jnp.argsort(order, stable=True).astype(jnp.int32)
+    n_elig = elig.sum().astype(jnp.int32)
+    k = jnp.where(spread > 0, jnp.minimum(spread, n_elig), n_elig)
+    sel = elig & (rank < k)
+    return sel, rank
+
+
+@jax.jit
+def solve_batched(demand, cand, alloc, region, home_region, spread,
+                  locality_weight):
+    """The device program: [W] demand, [W,P] candidates, [P] (or [W,P])
+    capacity/region vectors -> int32 [W,P] assignment."""
+    alloc2 = jnp.broadcast_to(alloc, cand.shape).astype(jnp.int32)
+    region2 = jnp.broadcast_to(region, cand.shape).astype(jnp.int32)
+    sel, rank = jax.vmap(_select_row, in_axes=(0, 0, 0, 0, 0, None, None))(
+        demand, cand, alloc2, region2, home_region, spread, locality_weight)
+    w = jnp.minimum(alloc2, CAP_CLIP).astype(jnp.int32)
+    return split_replicas_weighted(
+        jnp.minimum(demand, DEMAND_CLIP).astype(jnp.int32), w, sel, rank)
+
+
+def solve_host(demand, cand, alloc, region, home_region, spread=0,
+               locality_weight=DEFAULT_LOCALITY_WEIGHT) -> np.ndarray:
+    """Numpy twin of solve_batched — the same integer ops in the same
+    order, so assignments match the device program byte-for-byte."""
+    demand = np.minimum(np.asarray(demand, np.int32), DEMAND_CLIP)
+    cand = np.asarray(cand, bool)
+    alloc2 = np.broadcast_to(np.asarray(alloc, np.int32), cand.shape)
+    region2 = np.broadcast_to(np.asarray(region, np.int32), cand.shape)
+    home = np.asarray(home_region, np.int32)
+    elig = cand & (alloc2 > 0)
+    w = np.minimum(alloc2, CAP_CLIP).astype(np.int32)
+    score = np.where(region2 == home[:, None], np.int32(locality_weight),
+                     np.int32(0)) + w
+    neg = np.where(elig, -score, np.int32(_INT32_MAX)).astype(np.int32)
+    order = np.argsort(neg, axis=-1, kind="stable")
+    rank = np.argsort(order, axis=-1, kind="stable").astype(np.int32)
+    n_elig = elig.sum(axis=-1).astype(np.int32)
+    k = np.where(spread > 0, np.minimum(np.int32(spread), n_elig), n_elig)
+    sel = elig & (rank < k[:, None])
+    wsel = np.where(sel, w, 0).astype(np.int32)
+    total = wsel.sum(axis=-1, keepdims=True)
+    base = (demand[:, None] * wsel) // np.maximum(total, 1)
+    rem = demand - base.sum(axis=-1)
+    extra = (rank < rem[:, None]) & sel
+    return np.where(sel & (total > 0),
+                    base + extra.astype(np.int32), 0).astype(np.int32)
+
+
+def solve_sharded(mesh, demand, cand, alloc, region, home_region, spread=0,
+                  locality_weight=DEFAULT_LOCALITY_WEIGHT) -> np.ndarray:
+    """Full solve with the row dimension sharded over a parallel/mesh.py
+    mesh (rows over hosts x tenants like every [B] batch dimension; the
+    [P] fleet vectors replicate). Rows pad to the mesh's row factor."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel.mesh import HOSTS_AXIS, TENANTS_AXIS, row_factor
+
+    W = int(np.asarray(demand).shape[0])
+    rows = row_factor(mesh)
+    Wp = max(((W + rows - 1) // rows) * rows, rows)
+    row_axes = ((HOSTS_AXIS, TENANTS_AXIS)
+                if HOSTS_AXIS in mesh.axis_names else TENANTS_AXIS)
+    row_s = NamedSharding(mesh, P(row_axes))
+    mat_s = NamedSharding(mesh, P(row_axes, None))
+    rep_s = NamedSharding(mesh, P())
+
+    def pad_rows(a, sharding):
+        a = np.asarray(a)
+        out = np.zeros((Wp,) + a.shape[1:], a.dtype)
+        out[:W] = a
+        return jax.device_put(out, sharding)
+
+    out = solve_batched(
+        pad_rows(np.asarray(demand, np.int32), row_s),
+        pad_rows(np.asarray(cand, bool), mat_s),
+        jax.device_put(np.asarray(alloc, np.int32), rep_s),
+        jax.device_put(np.asarray(region, np.int32), rep_s),
+        pad_rows(np.asarray(home_region, np.int32), row_s),
+        jnp.int32(spread), jnp.int32(locality_weight))
+    return np.asarray(out)[:W]
+
+
+class FleetSolver:
+    """Incremental wrapper: a [W, P] assignment cache where re-solves
+    gather only the changed rows through the (padded, shape-stable)
+    device program and scatter the results back."""
+
+    def __init__(self, spread: int = 0,
+                 locality_weight: int = DEFAULT_LOCALITY_WEIGHT,
+                 backend: str = "tpu", mesh=None):
+        self.spread = int(spread)
+        self.locality_weight = int(locality_weight)
+        self.backend = backend
+        self.mesh = mesh
+        self._counts: np.ndarray | None = None
+        self.stats = {"solves": 0, "rows_solved": 0, "rows_skipped": 0}
+
+    def solve(self, demand, cand, alloc, region, home_region,
+              rows=None) -> np.ndarray:
+        """Solve and return the full [W, P] assignment. ``rows`` (int
+        indices) restricts the device dispatch to those rows when the
+        cached shape still matches — the inventory-delta fast path."""
+        delay = maybe_fail("fleet.solve")
+        if delay:
+            time.sleep(delay)
+        t0 = time.perf_counter()
+        demand = np.asarray(demand, np.int32)
+        cand = np.asarray(cand, bool)
+        W, P = cand.shape
+        full = (rows is None or self._counts is None
+                or self._counts.shape != (W, P))
+        idx = np.arange(W) if full else np.unique(
+            np.asarray(rows, np.int64))
+        self.stats["solves"] += 1
+        self.stats["rows_solved"] += int(idx.size)
+        self.stats["rows_skipped"] += W - int(idx.size)
+        if full:
+            self._counts = np.zeros((W, P), np.int32)
+        if idx.size:
+            sub = self._dispatch(demand[idx], cand[idx], alloc, region,
+                                 np.asarray(home_region, np.int32)[idx])
+            self._counts[idx] = sub
+        REGISTRY.histogram(
+            "fleet_solve_seconds",
+            "fleet bin-pack solve latency").observe(time.perf_counter() - t0)
+        return self._counts
+
+    def _dispatch(self, demand, cand, alloc, region, home) -> np.ndarray:
+        if self.backend != "tpu":
+            return solve_host(demand, cand, alloc, region, home,
+                              self.spread, self.locality_weight)
+        if self.mesh is not None:
+            return solve_sharded(self.mesh, demand, cand, alloc, region,
+                                 home, self.spread, self.locality_weight)
+        n, P = cand.shape
+        npad, ppad = pad_pow2(max(n, 1)), pad_pow2(max(P, 1))
+        d = np.zeros(npad, np.int32)
+        d[:n] = demand
+        c = np.zeros((npad, ppad), bool)
+        c[:n, :P] = cand
+        a = np.zeros(ppad, np.int32)
+        a[:P] = np.asarray(alloc, np.int32)
+        r = np.zeros(ppad, np.int32)
+        r[:P] = np.asarray(region, np.int32)
+        h = np.zeros(npad, np.int32)
+        h[:n] = home
+        out = solve_batched(d, c, a, r, h, jnp.int32(self.spread),
+                            jnp.int32(self.locality_weight))
+        return np.asarray(out)[:n, :P]

@@ -49,6 +49,34 @@ def split_replicas(replicas: jax.Array, avail: jax.Array, balanced: bool = False
     return jnp.where(avail & (n > 0), leaf, 0)
 
 
+def split_replicas_weighted(
+    replicas: jax.Array, weights: jax.Array, sel: jax.Array, rank: jax.Array
+) -> jax.Array:
+    """Capacity-weighted split over a *selected* cluster subset.
+
+    replicas: int32 [B]   desired root replicas (callers clip <= 65535)
+    weights:  int32 [B,P] per-cluster weight (callers clip <= 32767 so
+                          replicas*weight stays inside int32)
+    sel:      bool  [B,P] solver-selected clusters (weight > 0 where True)
+    rank:     int32 [B,P] selection order, rank 0 = best score; selected
+                          clusters hold ranks 0..k-1 (fleet/solver.py's
+                          argsort-of-argsort makes this an invariant)
+    returns:  int32 [B,P] leaf counts: floor(replicas*w/W) each, then the
+                          remainder (< k, one per cluster) dealt to the
+                          best-ranked clusters. Integer-exact: the row sum
+                          equals replicas whenever anything is selected,
+                          and identical math on host numpy reproduces it
+                          bit-for-bit (no floats anywhere).
+    """
+    w = jnp.where(sel, weights, 0).astype(jnp.int32)
+    total = w.sum(axis=-1, keepdims=True)
+    total_safe = jnp.maximum(total, 1)
+    base = (replicas[:, None] * w) // total_safe
+    rem = replicas - base.sum(axis=-1)
+    extra = (rank < rem[:, None]) & sel
+    return jnp.where(sel & (total > 0), base + extra.astype(jnp.int32), 0)
+
+
 def aggregate_status(leaf_counters: jax.Array, leaf_mask: jax.Array) -> jax.Array:
     """Sum leaf status counters into root status counters.
 
@@ -67,4 +95,5 @@ def placement_changed(current: jax.Array, desired: jax.Array) -> jax.Array:
 
 
 split_replicas_jit = jax.jit(split_replicas, static_argnames=("balanced",))
+split_replicas_weighted_jit = jax.jit(split_replicas_weighted)
 aggregate_status_jit = jax.jit(aggregate_status)

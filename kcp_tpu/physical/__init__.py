@@ -1,3 +1,3 @@
-from .fake import FakeClusterAgent, PhysicalRegistry
+from .fake import ChurnDriver, FakeClusterAgent, PhysicalRegistry
 
-__all__ = ["PhysicalRegistry", "FakeClusterAgent"]
+__all__ = ["PhysicalRegistry", "FakeClusterAgent", "ChurnDriver"]

@@ -68,6 +68,135 @@ class PhysicalRegistry:
         return self._fakes.get(name)
 
 
+class ChurnDriver:
+    """Seeded, replayable health/capacity churn over a fleet of fake
+    pclusters — shared by the fleet scenarios and the unit tests instead
+    of ad-hoc per-test condition flipping.
+
+    The whole fleet story is a *pure function of the constructor
+    arguments*: capacities are drawn once from a skewed lognormal,
+    locality labels round-robin over ``regions``, and every Ready flap is
+    precomputed as per-cluster NotReady intervals — same (seed, n, ticks,
+    rates) ⇒ the same schedule bit-for-bit on any host, so a scenario
+    scorecard names the seed and anyone can replay the run.
+
+    Two kinds of outage drive the hysteresis story:
+
+    - *flaps*: NotReady dips of ``flap_len`` ticks — shorter than the
+      evacuation hysteresis window, so the inventory must ride through
+      them with ZERO placement churn
+    - *outages*: sustained NotReady of ``outage_len`` ticks — these must
+      evacuate past the window and readmit on recovery
+
+    ``capacity_churn`` additionally shrinks a cluster's allocatable to
+    half its capacity for the duration of an outage-free "pressure"
+    interval, exercising capacity-delta re-solves without a health edge.
+    """
+
+    def __init__(self, n: int, seed: int = 0, ticks: int = 64,
+                 flap_rate: float = 0.05, flap_len: int = 1,
+                 outage_rate: float = 0.008, outage_len: int = 12,
+                 capacity_churn: float = 0.01,
+                 base_capacity: int = 64, skew: float = 1.0,
+                 regions: tuple[str, ...] = ("us-east", "us-west",
+                                             "eu-west", "ap-south")):
+        import numpy as np
+
+        if ticks < 1 or n < 1:
+            raise ValueError("ChurnDriver needs n >= 1, ticks >= 1")
+        self.n, self.ticks, self.seed = n, ticks, seed
+        rng = np.random.default_rng(seed)
+        self.names = [f"pc-{i:04d}" for i in range(n)]
+        # skewed capacity: a few big clusters, a long tail of small ones
+        self.capacity = np.maximum(
+            1, np.round(base_capacity * rng.lognormal(0.0, skew, n))
+        ).astype(np.int64)
+        self.region = [regions[int(r)] for r in rng.integers(0, len(regions), n)]
+        down = np.zeros((ticks, n), dtype=bool)
+        pressure = np.zeros((ticks, n), dtype=bool)
+        flap_starts = rng.random((ticks, n)) < flap_rate
+        outage_starts = rng.random((ticks, n)) < outage_rate
+        pressure_starts = rng.random((ticks, n)) < capacity_churn
+        for t in range(ticks):
+            for starts, length, mask in ((flap_starts, flap_len, down),
+                                         (outage_starts, outage_len, down),
+                                         (pressure_starts, outage_len,
+                                          pressure)):
+                idx = starts[t].nonzero()[0]
+                if idx.size:
+                    mask[t:t + length, idx] = True
+        self._down = down
+        self._pressure = pressure
+
+    # ------------------------------------------------------ pure queries
+
+    def ready_at(self, tick: int) -> "list[bool]":
+        """Per-cluster Ready at ``tick`` (ticks past the end = final
+        state healed: everything Ready — scenarios settle there)."""
+        if tick >= self.ticks:
+            return [True] * self.n
+        return (~self._down[tick]).tolist()
+
+    def allocatable_at(self, tick: int) -> "list[int]":
+        """Health-adjusted allocatable at ``tick`` (pressure halves it)."""
+        caps = self.capacity.copy()
+        if tick < self.ticks:
+            caps[self._pressure[tick]] //= 2
+        return caps.tolist()
+
+    def transitions(self, tick: int) -> list[tuple[int, bool]]:
+        """(cluster index, now-ready) edges between tick-1 and tick —
+        tick 0 is measured against the all-Ready birth state."""
+        now = self.ready_at(tick)
+        prev = [True] * self.n if tick == 0 else self.ready_at(tick - 1)
+        return [(i, now[i]) for i in range(self.n) if now[i] != prev[i]]
+
+    def flap_count(self) -> int:
+        import numpy as np
+
+        edges = np.diff(self._down.astype(np.int8), axis=0)
+        return int((edges != 0).sum() + self._down[0].sum())
+
+    # --------------------------------------------- Cluster-API applicator
+
+    def seed_fleet(self, client: Client) -> None:
+        """Create the fleet's Cluster objects (capacity + locality set,
+        all Ready) in ``client``'s logical cluster."""
+        from ..apis import cluster as capi
+
+        for i, name in enumerate(self.names):
+            obj = capi.new_cluster(name, kubeconfig=f"fake://{name}")
+            capi.set_capacity(obj, int(self.capacity[i]),
+                              region=self.region[i])
+            capi.set_ready(obj)
+            client.create(capi.CLUSTERS, obj)
+
+    def apply(self, client: Client, tick: int) -> int:
+        """Write ``tick``'s health/capacity deltas onto the Cluster
+        objects (delta-based: untouched clusters see no write). Returns
+        the number of objects updated."""
+        from ..apis import cluster as capi
+
+        ready = self.ready_at(tick)
+        alloc = self.allocatable_at(tick)
+        prev_alloc = (self.allocatable_at(tick - 1) if tick > 0
+                      else self.capacity.tolist())
+        changed = {i for i, _ in self.transitions(tick)}
+        changed.update(i for i in range(self.n)
+                       if alloc[i] != prev_alloc[i])
+        for i in sorted(changed):
+            obj = client.get(capi.CLUSTERS, self.names[i])
+            if ready[i]:
+                capi.set_ready(obj)
+            else:
+                capi.set_not_ready(obj, capi.REASON_SYNCER_NOT_READY,
+                                   "churn: heartbeat missed")
+            obj.setdefault("status", {})["allocatable"] = {
+                capi.CAPACITY_KEY: alloc[i]}
+            client.update_status(capi.CLUSTERS, obj)
+        return len(changed)
+
+
 class FakeClusterAgent:
     """Simulates a physical cluster's deployment controller: any
     Deployment becomes fully ready shortly after creation/update."""

@@ -27,15 +27,14 @@ from __future__ import annotations
 
 import copy
 import logging
-import time
 from typing import Sequence
 
 import numpy as np
 
-from ...apis.cluster import CLUSTERS, READY
-from ...apis.conditions import FALSE, find_condition
+from ...apis.cluster import CLUSTERS
 from ...apis.scheme import GVR
 from ...client import Client, Informer
+from ...fleet.inventory import ClusterInventory
 from ...ops.encode import pad_pow2
 from ...ops.placement import aggregate_status_jit
 from ...reconciler.controller import BatchController
@@ -81,6 +80,8 @@ class DeploymentSplitter:
         max_pclusters: int = 8,
         core=None,
         evac_hysteresis: float = DEFAULT_EVAC_HYSTERESIS,
+        place: bool = True,
+        inventory: ClusterInventory | None = None,
     ):
         self.client = client
         self.backend = backend
@@ -89,16 +90,21 @@ class DeploymentSplitter:
         self._pbucket = None
         self.rebalance = rebalance
         self.max_pclusters = max_pclusters
-        # health-gated evacuation state: when a cluster's Ready condition
-        # went explicitly False, which clusters are drained, and which
-        # roots must re-split even without `rebalance` (drain/readmit)
+        # health-gated evacuation now lives in the shared fleet inventory
+        # (fleet/inventory.py): Ready flips arm its hysteresis FSM, and
+        # the same instance feeds the FleetScheduler when one is driving.
+        # `place=False` hands the placement *decision* to that scheduler
+        # while this controller keeps informers, status fan-in and drains.
         self.evac_hysteresis = evac_hysteresis
-        self._notready_since: dict[tuple[str, str], float] = {}
-        self._evacuated: set[tuple[str, str]] = set()
+        self.inventory = (inventory if inventory is not None
+                          else ClusterInventory(evac_hysteresis=evac_hysteresis))
+        self.place = place
+        self.replan_sink = None  # FleetScheduler's evac/readmit intake
         self._force_replan: set[tuple[str, str, str]] = set()
         self.informer = Informer(client, DEPLOYMENTS)
         self.cluster_informer = Informer(client, CLUSTERS)
         self.informer.add_indexer("owned_by", self._owned_by_index)
+        self.informer.add_indexer("by_workspace", self._by_workspace_index)
         self.controller = BatchController(
             "deployment-splitter", self._process_batch,
             # item = ("root"|"leaf", (clusterName, ns, name)): fairness is
@@ -126,6 +132,20 @@ class DeploymentSplitter:
             return []
         return [f'{m.get("clusterName", "")}/{m.get("namespace", "")}/{owner}']
 
+    @staticmethod
+    def _by_workspace_index(obj: dict) -> list[str]:
+        """Roots keyed by logical cluster — replans look up ONE workspace
+        instead of scanning every object of every tenant."""
+        if not is_root(obj):
+            return []
+        return [obj["metadata"].get("clusterName", "")]
+
+    @property
+    def _evacuated(self) -> frozenset:
+        """(workspace, cluster) pairs currently evacuated (a read-only
+        view over the shared inventory; kept for tests/introspection)."""
+        return self.inventory.evacuated_pairs
+
     # ------------------------------------------------------------ events
 
     def _on_event(self, etype: str, old: dict | None, new: dict | None) -> None:
@@ -144,81 +164,61 @@ class DeploymentSplitter:
         lc = obj["metadata"].get("clusterName", "")
         name = obj["metadata"]["name"]
         ckey = (lc, name)
-        # health gate: the cluster reconciler's Ready flips feed placement
-        # here. NotReady starts the hysteresis clock (a delayed "health"
-        # item decides); Ready clears it — and readmits an evacuated
-        # cluster, re-splitting its logical cluster's roots
-        if etype == "DELETED":
-            self._notready_since.pop(ckey, None)
-            self._evacuated.discard(ckey)
-        elif self._explicitly_not_ready(new):
-            if ckey not in self._notready_since:
-                self._notready_since[ckey] = time.monotonic()
-                self.controller.enqueue_after(
-                    ("health", ckey), self.evac_hysteresis)
-        else:
-            self._notready_since.pop(ckey, None)
-            if ckey in self._evacuated:
-                self._evacuated.discard(ckey)
-                REGISTRY.counter(
-                    "cluster_readmissions_total",
-                    "evacuated clusters readmitted on Ready recovery").inc()
-                log.info("deployment-splitter: cluster %s/%s Ready again; "
-                         "readmitting and re-splitting its roots", lc, name)
-                self._replan_roots(lc)
+        # health gate: the cluster reconciler's Ready flips feed the
+        # shared fleet inventory's hysteresis FSM. NotReady arms the
+        # clock (a delayed "health" item decides); Ready inside the
+        # window disarms it with ZERO churn; Ready after evacuation
+        # readmits the cluster and re-splits its workspace's roots
+        d = self.inventory.observe(lc, obj, etype)
+        if d.notready_started:
+            self.controller.enqueue_after(
+                ("health", ckey), self.evac_hysteresis)
+        if d.readmitted:
+            log.info("deployment-splitter: cluster %s/%s Ready again; "
+                     "readmitting and re-splitting its roots", lc, name)
+            self._replan_roots(lc)
         # the cluster set changed: with rebalancing on, every root in that
-        # logical cluster gets re-planned
+        # logical cluster gets re-planned (indexed — no fleet-wide scan)
         if not self.rebalance:
             return
-        for obj in self.informer.list():
-            if is_root(obj) and obj["metadata"].get("clusterName", "") == lc:
-                m = obj["metadata"]
-                self.controller.enqueue(
-                    ("root", (lc, m.get("namespace", ""), m["name"]))
-                )
+        for obj in self.informer.index("by_workspace", lc):
+            m = obj["metadata"]
+            self.controller.enqueue(
+                ("root", (lc, m.get("namespace", ""), m["name"]))
+            )
 
     # --------------------------------------------- health-gated evacuation
 
-    @staticmethod
-    def _explicitly_not_ready(obj: dict | None) -> bool:
-        """Only a PRESENT Ready condition with status False counts —
-        clusters that never reported health (fresh registrations, test
-        fakes) stay placement-eligible."""
-        if obj is None:
-            return False
-        c = find_condition(obj, READY)
-        return c is not None and c.get("status") == FALSE
-
     def _replan_roots(self, lc: str) -> None:
         """Force every root in a logical cluster through a fresh split
-        (drain or readmit must move replicas even without `rebalance`)."""
-        for obj in self.informer.list():
-            if is_root(obj) and obj["metadata"].get("clusterName", "") == lc:
-                m = obj["metadata"]
-                rkey = (lc, m.get("namespace", ""), m["name"])
-                self._force_replan.add(rkey)
-                self.controller.enqueue(("root", rkey))
+        (drain or readmit must move replicas even without `rebalance`).
+        Routed through the by_workspace index — a Ready flip touches ONE
+        workspace's roots, never a fleet-wide rescan. With the placement
+        decision delegated (`place=False`) the keys flow to the fleet
+        scheduler's sink instead."""
+        rkeys = []
+        for obj in self.informer.index("by_workspace", lc):
+            m = obj["metadata"]
+            rkeys.append((lc, m.get("namespace", ""), m["name"]))
+        if not self.place:
+            if self.replan_sink is not None:
+                self.replan_sink(lc, rkeys)
+            return
+        for rkey in rkeys:
+            self._force_replan.add(rkey)
+            self.controller.enqueue(("root", rkey))
 
     def _check_health(self, ckey: tuple[str, str]) -> None:
         """The delayed hysteresis decision: evacuate only if the cluster
-        is STILL explicitly NotReady a full window after the flip."""
+        is STILL explicitly NotReady a full window after the flip (the
+        inventory re-checks its event-fed state and bumps its version
+        only on the pending->evacuated transition)."""
         lc, name = ckey
-        since = self._notready_since.get(ckey)
-        if since is None or ckey in self._evacuated:
-            return  # recovered within the window (zero churn), or done
-        if not self._explicitly_not_ready(self.cluster_informer.get(lc, name)):
-            self._notready_since.pop(ckey, None)
-            return
-        if time.monotonic() - since < self.evac_hysteresis - 1e-3:
-            return  # a newer flap rescheduled its own check
-        self._evacuated.add(ckey)
-        REGISTRY.counter(
-            "cluster_evacuations_total",
-            "physical clusters drained after sustained NotReady").inc()
-        log.warning("deployment-splitter: evacuating cluster %s/%s after "
-                    "sustained NotReady (> %.1fs)", lc, name,
-                    self.evac_hysteresis)
-        self._replan_roots(lc)
+        if self.inventory.check_evacuate(lc, name):
+            log.warning("deployment-splitter: evacuating cluster %s/%s "
+                        "after sustained NotReady (> %.1fs)", lc, name,
+                        self.evac_hysteresis)
+            self._replan_roots(lc)
 
     # -------------------------------------------------------------- tick
 
@@ -230,7 +230,8 @@ class DeploymentSplitter:
             if kind == "health":
                 self._check_health(key)
             elif kind == "root":
-                roots[key] = None
+                if self.place:  # else the FleetScheduler decides
+                    roots[key] = None
             else:
                 aggregates[key] = None
 
@@ -430,8 +431,8 @@ class DeploymentSplitter:
         return sorted(
             (c for c in self.cluster_informer.list()
              if c["metadata"].get("clusterName", "") == logical_cluster
-             and (logical_cluster, c["metadata"]["name"])
-             not in self._evacuated),
+             and not self.inventory.is_evacuated(
+                 logical_cluster, c["metadata"]["name"])),
             key=lambda c: c["metadata"]["name"],
         )
 
@@ -448,20 +449,34 @@ class DeploymentSplitter:
         # between existing leafs even when `rebalance` is off
         forced = key in self._force_replan
         scoped = self.client.scoped(lc)
+        # churn = replica-moving writes AFTER initial placement (updates,
+        # drains, late creates on readmission) — the bounded-migration
+        # number the fleet scenarios assert on. Initial splits are free.
+        had_leafs = bool(existing_leafs)
+        churn = 0
+        REGISTRY.counter(
+            "placement_resolves_total",
+            "root placements solved and applied (initial or re-solve)").inc()
         if not clusters:
             if forced:
                 # every cluster is evacuated: drain ALL placed leafs
                 for stale in existing_leafs:
-                    self._drain_leaf(scoped, lc, ns, stale)
+                    churn += self._drain_leaf(scoped, lc, ns, stale)
             fresh = scoped.get(DEPLOYMENTS, name, ns)
-            fresh.setdefault("status", {})["conditions"] = [{
+            conds = [{
                 "type": "Progressing",
                 "status": "False",
                 "reason": "NoRegisteredClusters",
                 "message": "kcp has no clusters registered to receive Deployments",
             }]
-            scoped.update_status(DEPLOYMENTS, fresh, namespace=ns)
+            # idempotent: a re-applied no-candidate placement must not
+            # rewrite identical status — the write bumps the root's RV,
+            # which re-enqueues the root and re-solves it forever
+            if (fresh.get("status") or {}).get("conditions") != conds:
+                fresh.setdefault("status", {})["conditions"] = conds
+                scoped.update_status(DEPLOYMENTS, fresh, namespace=ns)
             self._force_replan.discard(key)
+            self._count_churn(churn)
             return
         by_name = {leaf["metadata"]["name"]: leaf for leaf in existing_leafs}
         for j, cl in enumerate(clusters):
@@ -488,29 +503,42 @@ class DeploymentSplitter:
                 leaf.setdefault("spec", {})["replicas"] = desired_replicas
                 scoped.create(DEPLOYMENTS, leaf, namespace=ns)
                 self.stats["splits"] += 1
+                if had_leafs:
+                    churn += 1
             elif ((self.rebalance or forced)
                   and existing.get("spec", {}).get("replicas") != desired_replicas):
                 fresh = scoped.get(DEPLOYMENTS, lname, ns)
                 fresh["spec"]["replicas"] = desired_replicas
                 scoped.update(DEPLOYMENTS, fresh, namespace=ns)
                 self.stats["splits"] += 1
+                churn += 1
         # rebalance/forced: drop leafs for clusters that no longer exist
         # or were evacuated
         if self.rebalance or forced:
             for stale in by_name.values():
-                self._drain_leaf(scoped, lc, ns, stale)
+                churn += self._drain_leaf(scoped, lc, ns, stale)
         self._force_replan.discard(key)
+        self._count_churn(churn)
 
-    def _drain_leaf(self, scoped: Client, lc: str, ns: str, leaf: dict) -> None:
+    @staticmethod
+    def _count_churn(churn: int) -> None:
+        if churn:
+            REGISTRY.counter(
+                "placement_churn_total",
+                "replica-moving leaf writes after initial placement "
+                "(updates, drains, readmission creates)").inc(churn)
+
+    def _drain_leaf(self, scoped: Client, lc: str, ns: str, leaf: dict) -> int:
         try:
             scoped.delete(DEPLOYMENTS, leaf["metadata"]["name"], ns)
         except errors.NotFoundError:
-            return
-        if (lc, _labels(leaf).get(CLUSTER_LABEL, "")) in self._evacuated:
+            return 0
+        if self.inventory.is_evacuated(lc, _labels(leaf).get(CLUSTER_LABEL, "")):
             REGISTRY.counter(
                 "evacuations_total",
                 "leaf deployments drained off evacuated "
                 "(sustained-NotReady) clusters").inc()
+        return 1
 
     def _apply_aggregation(
         self, key: tuple[str, str, str], root: dict, leafs: list[dict], sums: np.ndarray
@@ -538,7 +566,7 @@ class DeploymentSplitter:
     async def start(self) -> None:
         import asyncio
 
-        if self.fused:
+        if self.fused and self.place:
             if self.core is None:
                 from ...syncer.core import FusedCore
 
@@ -558,7 +586,7 @@ class DeploymentSplitter:
         import asyncio
 
         await self.controller.stop()
-        if self.fused and self.core is not None:
+        if self.fused and self.place and self.core is not None:
             await self.core.stop()
             # the core's shutdown drain may have enqueued final applies
             if self._apply_q is not None:

@@ -32,7 +32,7 @@ import time
 from urllib.parse import urlsplit
 
 from .. import obs
-from ..faults import maybe_fail
+from ..faults import link_fault, maybe_fail
 from ..server.rest import RestWatch, _status_error
 from ..utils import errors
 from ..utils.circuit import CircuitBreaker
@@ -243,6 +243,12 @@ class ReplicationApplier:
                 ssl_ctx = client_context(self._ca_data, self._ca_file)
         conn = None
         try:
+            # a peer-scoped link.partition makes the probe target
+            # unreachable from THIS follower (ConnectionError -> None),
+            # which is what drives the breaker open and the promotion
+            d = link_fault(self.role, f"{host}:{port}")
+            if d:
+                time.sleep(d)
             if tls:
                 conn = http.client.HTTPSConnection(
                     host, port, timeout=1.0, context=ssl_ctx)
@@ -296,6 +302,23 @@ class ReplicationApplier:
                          token=self.token, ssl_context=self._ssl)
         got_header = False
         in_snapshot = False
+
+        async def _link_sentinel() -> None:
+            # WAN realism: a peer-scoped partition must sever an
+            # ESTABLISHED feed, not just refuse new connects — an idle
+            # stream would otherwise keep a partitioned standby happy
+            # forever and promotion would never fire. Poll the link
+            # fault point and kill the stream the moment the path to
+            # the primary is cut (real TCP would time out the same way).
+            while True:
+                await asyncio.sleep(self.probe_interval_s)
+                try:
+                    link_fault(self.role, f"{self._host}:{self._port}")
+                except ConnectionError:
+                    ws.close()
+                    return
+
+        sentinel = asyncio.ensure_future(_link_sentinel())
         try:
             while True:
                 msg = await ws.next()
@@ -366,6 +389,7 @@ class ReplicationApplier:
                         and self._sub_id is not None:
                     await self._send_ack()
         finally:
+            sentinel.cancel()
             ws.close()
             self.connected = False
 
@@ -378,6 +402,9 @@ class ReplicationApplier:
     def _ack_blocking(self, sid: int, rv: int) -> None:
         conn = None
         try:
+            d = link_fault(self.role, f"{self._host}:{self._port}")
+            if d:
+                time.sleep(d)
             if self._tls:
                 conn = http.client.HTTPSConnection(
                     self._host, self._port, timeout=5.0, context=self._ssl)
@@ -439,6 +466,12 @@ class ReplicationApplier:
     def _fence_blocking(self, epoch: int) -> bool:
         conn = None
         try:
+            # the partition-during-promotion drill's key property: while
+            # the old primary is unreachable the fence retries fail here,
+            # and the fence must still land once the link heals
+            d = link_fault(self.role, f"{self._host}:{self._port}")
+            if d:
+                time.sleep(d)
             if self._tls:
                 conn = http.client.HTTPSConnection(
                     self._host, self._port, timeout=2.0, context=self._ssl)

@@ -41,7 +41,7 @@ import json
 import logging
 from collections import deque
 
-from ..faults import maybe_fail
+from ..faults import link_fault, maybe_fail
 from ..utils.trace import REGISTRY
 
 log = logging.getLogger(__name__)
@@ -219,6 +219,11 @@ class ReplicationHub:
         the filtered snapshot IS the cluster's final state and the
         BARRIER rv bounds every RV it ever minted for it."""
         delay = maybe_fail("repl.ship")
+        # WAN-link realism: feed-side delay/partition scoped per
+        # subscriber role ("repl.feed" -> "replica"/"standby"/...) — a
+        # ConnectionError here kills this one follower's stream exactly
+        # like the wire dying, without touching co-subscribers
+        delay += link_fault("repl.feed", role or "replica")
         if delay:
             await asyncio.sleep(delay)
         if sub_epoch > self.store.epoch:
@@ -307,6 +312,9 @@ class ReplicationHub:
                 if draining:
                     batch = [ln for ln in batch if ln]
                 delay = maybe_fail("repl.ship")
+                # per-batch WAN delay: a slow link to THIS follower lags
+                # its applied RV without slowing the other subscribers
+                delay += link_fault("repl.feed", role or "replica")
                 if delay:
                     await asyncio.sleep(delay)
                 if batch:

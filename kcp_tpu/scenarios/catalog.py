@@ -277,8 +277,146 @@ WRITE_STORM = ScenarioSpec(
     ),
 )
 
+FLEET_CHURN = ScenarioSpec(
+    name="fleet-churn",
+    description="Hundreds of physical clusters with skewed capacity "
+                "flap Ready/NotReady in a seeded storm while the "
+                "in-server fleet control plane (KCP_FLEET=1) keeps "
+                "root Deployments placed: every flap stays inside the "
+                "evacuation hysteresis, so the storm phase must move "
+                "ZERO replicas and evacuate ZERO pclusters — and the "
+                "healed fleet's live assignment must equal the numpy "
+                "host twin's answer for the final state.",
+    topology="monolith",
+    topology_args={"controllers": True},
+    tenants=2,
+    watchers_per_tenant=0,
+    workload="fleet",
+    env={"KCP_FLEET": "1"},
+    options={"pclusters": 200, "roots": 30, "ticks": 6,
+             "flap_rate": 0.15, "skew": 1.0},
+    phases=(Phase("seed", settle_s=0.3),
+            Phase("storm", settle_s=0.3),
+            Phase("verify", settle_s=0.3)),
+    slos=(
+        SLO("zero-churn-under-flaps", "fleet_storm_churn", "==", 0),
+        SLO("zero-evacuations-under-flaps", "fleet_storm_evacuations",
+            "==", 0),
+        SLO("storm-actually-flapped", "fleet_flaps", ">=", 50),
+        SLO("seed-fully-placed", "fleet_seed_unplaced", "==", 0),
+        SLO("assignment-matches-host-twin", "assignment_mismatches",
+            "==", 0),
+        SLO("healed-fully-placed", "fleet_unplaced", "==", 0),
+        SLO("solver-actually-ran", "placement_resolves", ">=", 1),
+        SLO("driver-clean", "fleet_driver_errors", "==", 0),
+    ),
+)
+
+CAPACITY_SKEW = ScenarioSpec(
+    name="capacity-skew-binpack",
+    description="The BASELINE-shape bin-pack study: 10k workspaces "
+                "over 8 pclusters with lognormal-skewed capacity, "
+                "solved in ONE device batch. The assignment must be "
+                "byte-identical to the numpy host twin, never "
+                "overcommit a row or land on a non-candidate, and a "
+                "37-row candidate delta must re-solve exactly those "
+                "rows to the same answer a from-scratch solve gives.",
+    topology="none",
+    tenants=2,
+    watchers_per_tenant=0,
+    workload="placement",
+    options={"workspaces": 10000, "pclusters": 8, "spread": 2,
+             "skew": 1.2, "dirty_rows": 37},
+    phases=(Phase("solve", settle_s=0.0),),
+    slos=(
+        SLO("baseline-shape", "placement_rows", ">=", 10000),
+        SLO("assignment-byte-identical", "placement_mismatches",
+            "==", 0),
+        SLO("no-overcommitted-rows", "placement_overcommit_rows",
+            "==", 0),
+        SLO("never-onto-non-candidates",
+            "placement_noncandidate_replicas", "==", 0),
+        SLO("incremental-touches-only-dirty-rows",
+            "placement_incremental_extra_rows", "==", 0),
+        SLO("incremental-matches-full-solve",
+            "placement_incremental_mismatches", "==", 0),
+        SLO("batched-solve-bounded", "placement_batched_ms",
+            "<=", 5000.0),
+        SLO("driver-clean", "placement_driver_errors", "==", 0),
+    ),
+)
+
+PARTITION_PROMOTION = ScenarioSpec(
+    name="partition-during-promotion",
+    description="A WAN partition cuts every peer's link TO the primary "
+                "(feed fan-out stays up — the partition is directed) "
+                "mid-workload: the standby's probes fail, it promotes "
+                "behind the epoch fence, the router re-homes writes "
+                "onto it, and when the link heals the fence lands on "
+                "the old primary. The epoch fence must HOLD: zero "
+                "acked writes lost, exactly one writable primary at "
+                "the end, the fenced ex-primary behind the promoted "
+                "epoch with no commits the new primary never saw.",
+    topology="replicated",
+    tenants=5,
+    watchers_per_tenant=2,
+    phases=(Phase("warm", ops_per_tenant=25),
+            Phase("partition", ops_per_tenant=60,
+                  faults="link.partition:drop@peer=*>{primary}",
+                  settle_s=2.0),
+            Phase("healed", ops_per_tenant=25, settle_s=2.0)),
+    options={"pace_s": 0.02, "coverage_timeout_s": 30.0},
+    slos=(
+        SLO("no-lost-acked-writes", "lost_acked_writes", "==", 0),
+        SLO("partition-actually-cut",
+            "fault_injected_link_partition", ">=", 1),
+        SLO("standby-promoted", "repl_promotions", ">=", 1),
+        SLO("router-rerouted-writes", "router_rehome", ">=", 1),
+        SLO("one-writable-primary", "writable_primaries", "==", 1),
+        SLO("old-primary-fenced", "fenced_nodes", ">=", 1),
+        SLO("no-dual-primary-commits", "stale_primary_excess_rv",
+            "==", 0),
+        SLO("epoch-fence-held", "epoch_fence_held", "==", 1),
+        SLO("no-lost-watch-events", "lost_watch_events", "==", 0),
+        SLO("error-budget-5xx", "http_5xx", "<=", 2000),
+    ),
+)
+
+WAN_REPLICA_LAG = ScenarioSpec(
+    name="wan-replica-lag",
+    description="The replica's feed link crosses a slow WAN path "
+                "(seeded 30-60ms per batch, jittered) while writes "
+                "continue at full rate: the primary's fan-out must lag "
+                "ONLY that follower (the semi-sync standby acks at LAN "
+                "speed, so client acks never slow), and once the link "
+                "heals the replica must drain its lag to zero — "
+                "bounded staleness, not silent divergence.",
+    topology="replicated",
+    tenants=5,
+    watchers_per_tenant=1,
+    phases=(Phase("warm", ops_per_tenant=20),
+            Phase("lag", ops_per_tenant=60,
+                  faults="link.delay:latency=30ms@jitter=30ms"
+                         "@peer=repl.feed>replica",
+                  settle_s=1.0),
+            Phase("drain", ops_per_tenant=20, settle_s=2.0)),
+    options={"pace_s": 0.02, "coverage_timeout_s": 30.0},
+    slos=(
+        SLO("no-lost-acked-writes", "lost_acked_writes", "==", 0),
+        SLO("wan-delay-actually-fired",
+            "fault_injected_link_delay", ">=", 1),
+        SLO("replica-drained-after-heal", "replica_lag", "==", 0),
+        SLO("one-writable-primary", "writable_primaries", "==", 1),
+        SLO("no-spurious-promotion", "repl_promotions", "==", 0),
+        SLO("no-lost-watch-events", "lost_watch_events", "==", 0),
+        SLO("error-budget-5xx", "http_5xx", "==", 0),
+    ),
+)
+
 SCENARIOS: dict[str, ScenarioSpec] = {
     s.name: s for s in (CRUD_CHURN, NOISY_NEIGHBOR, RECONNECT_STORM,
                         ROLLING_RESTART, KILL_PRIMARY, CRD_CHURN,
-                        RING_CHANGE, SCALE_OUT, WRITE_STORM)
+                        RING_CHANGE, SCALE_OUT, WRITE_STORM,
+                        FLEET_CHURN, CAPACITY_SKEW, PARTITION_PROMOTION,
+                        WAN_REPLICA_LAG)
 }

@@ -57,7 +57,14 @@ TRACKED_COUNTERS = ("repl_promotions_total", "repl_rehome_total",
                     "store_commit_windows_total",
                     "repl_ack_batched_total",
                     "migration_records_total",
-                    "migration_fenced_writes_total")
+                    "migration_fenced_writes_total",
+                    "repl_fenced_writes_total",
+                    "fault_injected_link_partition_total",
+                    "fault_injected_link_delay_total",
+                    "placement_resolves_total",
+                    "placement_churn_total",
+                    "cluster_evacuations_total",
+                    "cluster_readmissions_total")
 
 
 def pctile(vals: list[float], q: float) -> float:
@@ -275,7 +282,13 @@ async def _drive(sspec: ScenarioSpec, seed: int, schedule, topology,
                 await asyncio.sleep(delay)
             inj = None
             if phase.faults:
-                inj = faults_mod.FaultInjector(phase.faults, seed)
+                # WAN-link specs name peers by ROLE ({primary}, ...);
+                # the topology knows the host:port each role landed on
+                fspec = phase.faults
+                if "{" in fspec and hasattr(topology, "peer_addrs"):
+                    for role, addr in topology.peer_addrs().items():
+                        fspec = fspec.replace("{" + role + "}", addr)
+                inj = faults_mod.FaultInjector(fspec, seed)
                 faults_mod.install(inj)
             try:
                 writer_futs = []
@@ -285,6 +298,20 @@ async def _drive(sspec: ScenarioSpec, seed: int, schedule, topology,
                         writer_futs.append(loop.run_in_executor(
                             None, run_crd_tenant, base, tenant_name(ti),
                             ops, phase_idx, stats, shared))
+                elif sspec.workload == "fleet":
+                    from .fleetload import run_fleet_phase
+
+                    shared = measurements.setdefault("_fleet", {})
+                    writer_futs.append(loop.run_in_executor(
+                        None, run_fleet_phase, base, phase.name, sspec,
+                        seed, shared))
+                elif sspec.workload == "placement":
+                    from .fleetload import run_placement_phase
+
+                    shared = measurements.setdefault("_placement", {})
+                    writer_futs.append(loop.run_in_executor(
+                        None, run_placement_phase, phase.name, sspec,
+                        seed, shared))
                 else:
                     # smart_half: even-index tenants write DIRECT over
                     # the ring (SmartRestClient), odd ones stay routed —
@@ -353,7 +380,7 @@ def _fetch_slowest_traces(base_url: str, n: int = 3) -> list[dict]:
     from .. import obs
     from ..obs import assemble
 
-    if not obs.TRACER.enabled:
+    if not base_url or not obs.TRACER.enabled:
         return []
     client = RestClient(base_url)
     try:
@@ -522,6 +549,15 @@ def _collect(sspec: ScenarioSpec, stats: WriterStats, observers,
         m["crd_undestroyed"] = (sspec.tenants * down_beats
                                 - m["crd_torn_down"])
         m["lost_acked_writes"] = crd.get("cr_lost", 0)
+    # fleet/placement workload measurements: the driver's shared dict
+    # holds scratch state (_-prefixed) AND final numbers — fold only
+    # the numbers, under their final metric names
+    for key in ("_fleet", "_placement"):
+        drv_shared = m.pop(key, None)
+        if drv_shared is not None:
+            m.update({k: v for k, v in drv_shared.items()
+                      if not k.startswith("_")
+                      and isinstance(v, (int, float))})
     for name in TRACKED_COUNTERS:
         short = name[:-len("_total")]
         m[short] = REGISTRY.counter(name).value - counters_before[name]
@@ -543,6 +579,11 @@ def _run_pass(sspec: ScenarioSpec, seed: int, schedule, workdir: str
         topology.start()
         observers = asyncio.run(
             _drive(sspec, seed, schedule, topology, stats, measurements))
+        if hasattr(topology, "audit"):
+            # post-run replication facts (exactly-one-writable-primary,
+            # fencing landed, follower lag drained) — the partition and
+            # WAN-lag drills' SLOs key on these
+            measurements.update(topology.audit())
         if sspec.workload == "configmaps":
             _verify_final_state(topology.client_url, sspec,
                                 expected_final_state(schedule, sspec),

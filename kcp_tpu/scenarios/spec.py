@@ -54,7 +54,9 @@ class Phase:
 
     name: str
     ops_per_tenant: int = 0
-    faults: str = ""        # KCP_FAULTS spec installed for this phase
+    faults: str = ""        # KCP_FAULTS spec installed for this phase;
+    # {primary}/{standby}/{replica} template to the topology's
+    # host:port for that role (WAN link faults are peer-pair-scoped)
     action: str = ""        # engine action: rolling_restart_drain |
     # rolling_restart_kill | kill_primary | drop_watchers | flood |
     # move_shard (drain a shard, restart on a NEW address, republish
@@ -70,11 +72,11 @@ class ScenarioSpec:
 
     name: str
     description: str
-    topology: str                      # monolith | fleet | replicated
+    topology: str                      # monolith | fleet | replicated | none
     tenants: int
     phases: tuple[Phase, ...]
     slos: tuple[SLO, ...]
-    workload: str = "configmaps"       # configmaps | crd
+    workload: str = "configmaps"       # configmaps | crd | fleet | placement
     watchers_per_tenant: int = 1
     env: dict = field(default_factory=dict)       # server-process env
     options: dict = field(default_factory=dict)   # engine knobs

@@ -396,6 +396,82 @@ class ReplicatedPrimary:
     def client_url(self) -> str:
         return self.router.address
 
+    def peer_addrs(self) -> dict[str, str]:
+        """host:port per replication role. The engine templates
+        ``{primary}``/``{standby}``/``{replica}`` in phase fault specs
+        into these — link faults key on the netloc, not the URL."""
+        return {name: urlsplit(t.address).netloc
+                for name, t in (("primary", self.primary),
+                                ("standby", self.standby),
+                                ("replica", self.replica))
+                if t is not None}
+
+    def audit(self, timeout: float = 12.0) -> dict:
+        """Post-run replication facts for the scorecard: poll every
+        node's ``/replication/status`` until the constellation settles
+        — exactly one writable primary (fencing landed), every live
+        unfenced follower drained to the primary's applied RV — then
+        report. A fleet that never settles reports its last snapshot
+        and the SLOs fail loudly.
+
+        ``stale_primary_excess_rv`` is the dual-primary-commit
+        evidence: a fenced ex-primary that committed writes the
+        promoted primary never saw would sit AHEAD of it in the shared
+        RV sequence."""
+        from ..server.rest import RestClient
+        from ..utils import errors
+
+        def snap() -> dict:
+            out = {}
+            for name, t in (("primary", self.primary),
+                            ("standby", self.standby),
+                            ("replica", self.replica)):
+                if t is None:
+                    continue
+                c = RestClient(t.address)
+                try:
+                    out[name] = c._request(
+                        "GET", "/replication/status") or {}
+                except (errors.ApiError, ConnectionError, OSError):
+                    out[name] = None  # dead node (e.g. killed primary)
+                finally:
+                    c.close()
+            return out
+
+        deadline = time.time() + timeout
+        while True:
+            st = [s for s in snap().values() if s]
+            prim = [s for s in st
+                    if s.get("role") == "primary" and not s.get("fenced")
+                    and not s.get("read_only")]
+            fenced = [s for s in st if s.get("fenced")]
+            lag = excess = 0
+            if len(prim) == 1:
+                head = int(prim[0].get("applied_rv", 0) or 0)
+                epoch = int(prim[0].get("epoch", 0) or 0)
+                followers = [s for s in st
+                             if s is not prim[0] and not s.get("fenced")]
+                lag = max((head - int(s.get("applied_rv", 0) or 0)
+                           for s in followers), default=0)
+                excess = max((int(s.get("applied_rv", 0) or 0) - head
+                              for s in fenced), default=0)
+                # a fence stamps the SUPERSEDING epoch onto the sealed
+                # store, so a fenced node sitting AHEAD of the writable
+                # primary would mean a promotion this fleet never saw
+                ahead = any(int(s.get("epoch", 0) or 0) > epoch
+                            for s in fenced)
+                if lag == 0 and excess <= 0:
+                    break
+            if time.time() > deadline:
+                ahead = True
+                break
+            time.sleep(0.2)
+        return {"writable_primaries": len(prim),
+                "fenced_nodes": len(fenced),
+                "replica_lag": max(lag, 0),
+                "stale_primary_excess_rv": max(excess, 0),
+                "epoch_fence_held": int(len(prim) == 1 and not ahead)}
+
     def kill_primary(self) -> None:
         """SIGKILL-equivalent primary death (Server.kill: no WAL
         compaction, streams die mid-chunk)."""
@@ -408,6 +484,29 @@ class ReplicatedPrimary:
         self.router = self.replica = self.standby = self.primary = None
 
 
+class NullTopology:
+    """No servers at all. The placement-study workload is pure solver
+    work driven engine-side; ``client_url`` is empty and the engine
+    skips every HTTP-touching step (observers, traces, final-state
+    verify)."""
+
+    kind = "none"
+
+    def __init__(self, root_dir: str, env: dict | None = None):
+        self.root_dir = root_dir
+        self.env = env or {}
+
+    def start(self) -> "NullTopology":
+        return self
+
+    @property
+    def client_url(self) -> str:
+        return ""
+
+    def stop(self) -> None:
+        pass
+
+
 def make_topology(spec, root_dir: str):
     """Instantiate the topology a spec names."""
     args = dict(spec.topology_args)
@@ -417,4 +516,6 @@ def make_topology(spec, root_dir: str):
         return RouterFleet(root_dir, env=spec.env, **args)
     if spec.topology == "replicated":
         return ReplicatedPrimary(root_dir, env=spec.env, **args)
+    if spec.topology == "none":
+        return NullTopology(root_dir, env=spec.env)
     raise ValueError(f"unknown topology {spec.topology!r}")

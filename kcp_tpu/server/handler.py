@@ -157,6 +157,15 @@ class RestHandler:
         # in-stream Status, and returns — the half of "no watcher is
         # abandoned mid-stream" that the HTTP layer cannot do alone
         self.draining = asyncio.Event()
+        # epoch fence (POST /replication/fence): a fenced store can never
+        # deliver another watch event, so live watch producers end with
+        # the SAME terminal Status as drain (resumable from last_rv) and
+        # consumers re-resolve onto the promoted primary instead of
+        # idling on a sealed store forever. Separate from ``draining``
+        # because a fenced server keeps serving: /replication/status must
+        # answer probes/audits and writes must reach the store's own
+        # fenced refusal (repl_fenced_writes_total)
+        self.watch_fence = asyncio.Event()
         # watcher-scale serving (KCP_WATCH_COALESCE, default on): one
         # shared flush coalescer gathers every watch stream's encode-once
         # lines and writes each socket once per coalescing tick —
@@ -977,6 +986,11 @@ class RestHandler:
                     f"epoch {self.store.epoch}")
             if epoch > self.store.epoch:
                 self.store.fence(epoch)
+                # flush + terminate every live watch stream: an open
+                # watch on a fenced store would otherwise idle forever
+                # (no writes can commit here again), never seeing the
+                # promoted primary's events
+                self.watch_fence.set()
             # equal epoch: idempotent retry of an applied fence (or a
             # no-op against the current epoch's own primary)
             return Response.of_json(_status_body(
@@ -1292,6 +1306,7 @@ class RestHandler:
             loop = asyncio.get_event_loop()
             deadline = loop.time() + timeout if timeout else None
             drain_task: asyncio.Task | None = None
+            fence_task: asyncio.Task | None = None
 
             async def send_batch(batch) -> None:
                 # coalesce whatever else the watch already buffered
@@ -1364,7 +1379,7 @@ class RestHandler:
             try:
                 it = watch.__aiter__()
                 while True:
-                    if self.draining.is_set():
+                    if self.draining.is_set() or self.watch_fence.is_set():
                         await flush_and_terminate()
                         return
                     step = bookmark_every if bookmarks else 3600.0
@@ -1374,8 +1389,11 @@ class RestHandler:
                     if drain_task is None:
                         drain_task = asyncio.ensure_future(
                             self.draining.wait())
+                    if fence_task is None:
+                        fence_task = asyncio.ensure_future(
+                            self.watch_fence.wait())
                     done, _ = await asyncio.wait(
-                        {nxt, drain_task}, timeout=step,
+                        {nxt, drain_task, fence_task}, timeout=step,
                         return_when=asyncio.FIRST_COMPLETED)
                     ev = None
                     err: BaseException | None = None
@@ -1435,7 +1453,7 @@ class RestHandler:
                     if ev is not None:
                         await send_batch([ev, *watch.drain()])
                         continue
-                    if self.draining.is_set():
+                    if self.draining.is_set() or self.watch_fence.is_set():
                         await flush_and_terminate()
                         return
                     if deadline is not None and loop.time() >= deadline:
@@ -1481,7 +1499,7 @@ class RestHandler:
                 # retrieves any late exception (watch.close() below
                 # completes a pending __anext__ with StopAsyncIteration)
                 # so the loop never logs "exception was never retrieved"
-                for t in (nxt, drain_task):
+                for t in (nxt, drain_task, fence_task):
                     if t is not None and not t.done():
                         t.cancel()
                     if t is not None:

@@ -26,7 +26,7 @@ from urllib.parse import quote, urlsplit
 from .. import obs
 from ..analysis.sanitize import make_lock
 from ..apis.scheme import GVR, ResourceInfo, Scheme, default_scheme
-from ..faults import maybe_fail, should_drop
+from ..faults import link_fault, maybe_fail, should_drop
 from ..store.selectors import LabelSelector
 from ..store.store import INITIAL_EVENTS_END, WILDCARD, Event
 from ..utils import errors
@@ -121,6 +121,9 @@ class RestWatch:
     # class-level default so a skeletal instance (tests build one via
     # ``__new__`` to drive ``_feed`` directly) still parses bookmarks
     _initial_events = False
+    # source name for peer-scoped link faults (link.partition/link.delay);
+    # the destination is the watched server's host:port
+    link_src = "watch"
 
     def __init__(self, host: str, port: int, path: str, resource: str,
                  token: str = "", ssl_context=None,
@@ -160,6 +163,13 @@ class RestWatch:
     async def _run(self) -> None:
         reader = writer = None
         try:
+            # WAN-link realism: a peer-scoped partition cuts the stream at
+            # connect time exactly like a refused connection (the informer
+            # relists against another peer or backs off); link.delay adds
+            # the configured one-way latency before the connect
+            delay = link_fault(self.link_src, f"{self._host}:{self._port}")
+            if delay:
+                await asyncio.sleep(delay)
             reader, writer = await asyncio.open_connection(
                 self._host, self._port, ssl=self._ssl,
                 server_hostname=self._host if self._ssl else None)
@@ -358,6 +368,10 @@ class RestWatch:
 class RestClient:
     """HTTP twin of :class:`kcp_tpu.client.Client`."""
 
+    # source name for peer-scoped link faults; harnesses that model a
+    # specific vantage point (a router relay pool, a syncer) override it
+    link_src = "client"
+
     def __init__(self, base_url: str, cluster: str = "admin",
                  scheme: Scheme | None = None, token: str = "",
                  ca_data: bytes | str | None = None,
@@ -430,6 +444,10 @@ class RestClient:
         self._breaker.check()
         try:
             delay = maybe_fail("rest.request")
+            # WAN-link realism: a peer-scoped partition toward this
+            # server raises ConnectionError exactly where a refused
+            # connect would; link.delay models the one-way wire latency
+            delay += link_fault(self.link_src, f"{self._host}:{self._port}")
         except Exception:
             # injected transport failure: feed the breaker so chaos
             # schedules exercise the open/half-open transitions

@@ -113,6 +113,11 @@ class Config:
     repl_lag_max: int | None = None  # replicas refuse reads 503 past
     # this many records of lag (None -> KCP_REPL_LAG_MAX, default 0 =
     # serve any staleness RV-honestly)
+    fleet: bool = False  # fleet placement control plane (KCP_FLEET=1 env
+    # fallback): a FleetScheduler takes over the DeploymentSplitter's
+    # placement decision with the capacity/locality-aware batched
+    # bin-pack (kcp_tpu/fleet/). Spread + locality weight come from
+    # KCP_FLEET_SPREAD / KCP_FLEET_LOCALITY_WEIGHT.
 
 
 class Server:
@@ -414,6 +419,7 @@ class Server:
             self._installed_mesh = mesh
             log.info("serving mesh: %s",
                      dict(zip(mesh.axis_names, mesh.devices.shape)))
+        splitter = DeploymentSplitter(self.client)
         self._controllers = [
             NegotiationController(self.client,
                                   auto_publish=self.config.auto_publish_apis),
@@ -427,11 +433,17 @@ class Server:
                 **({"syncer_image": self.config.syncer_image}
                    if self.config.syncer_image else {}),
             ),
-            DeploymentSplitter(self.client),
+            splitter,
             # the reference's "start-namespace-controller" hook
             # (server.go:325-356)
             NamespaceLifecycleController(self.client),
         ]
+        if self.config.fleet or os.environ.get("KCP_FLEET") == "1":
+            from ..fleet.scheduler import FleetScheduler
+
+            # must start AFTER the splitter (it shares its informers);
+            # the controllers list starts in order
+            self._controllers.append(FleetScheduler(splitter, mesh=mesh))
         admission = getattr(self.handler, "admission", None)
         if admission is not None and admission.ledger is not None:
             # quota usage-recount reconciler (admission/quota.py):

@@ -106,6 +106,37 @@ print("fleet smoke ok: %sx rows/dispatch | %sx combined | %d buckets"
          drill["quarantined"]))
 '
 
+echo "== placement: fleet bin-pack smoke (batched-vs-per-workspace floor + assignment byte-equality)"
+# reduced-scale --placement lane (2k workspaces x 8 pclusters, 400-row
+# loop sample): the batched device solve must beat the pre-fleet
+# per-workspace host loop >=4x (the committed full-scale
+# BENCH_r11_placement.json measured ~15x at 10k x 8), stay byte-identical
+# to the numpy host twin AND the per-workspace answers, never overcommit
+# or land on a non-candidate, and the incremental re-solve must touch
+# exactly the dirty rows while matching a from-scratch recompute
+pl_line=$(JAX_PLATFORMS=cpu KCP_BENCH_PLACEMENT_WORKSPACES=2000 \
+    KCP_BENCH_PLACEMENT_LOOP_ROWS=400 KCP_BENCH_PLACEMENT_ITERS=3 \
+    python bench.py --placement | tail -1)
+printf '%s\n' "$pl_line" | python -c '
+import json, sys
+r = json.loads(sys.stdin.readline())
+pb = r["placement_bench"]
+assert pb["assignment_equal_host"], "batched assignment diverged from host twin"
+assert pb["assignment_equal_per_workspace"], (
+    "per-workspace loop diverged from the batched answer")
+assert pb["overcommit_rows"] == 0, pb
+assert pb["noncandidate_replicas"] == 0, pb
+inc = pb["incremental"]
+assert inc["rows_solved"] == inc["dirty_rows"], (
+    "incremental re-solve touched %d rows for %d dirty"
+    % (inc["rows_solved"], inc["dirty_rows"]))
+assert inc["mismatches"] == 0, inc
+assert r["value"] >= 4.0, "batched speedup %sx < 4x floor" % r["value"]
+print("placement smoke ok: %sx batched vs per-workspace | %d rows byte-identical"
+      " | incremental %d/%d rows, 0 mismatches"
+      % (r["value"], pb["workspaces"], inc["rows_solved"], inc["dirty_rows"]))
+'
+
 echo "== store: CPU microbench smoke (10k objects, 64 watches) with regression floor"
 store_line=$(KCP_BENCH_STORE_OBJECTS=10000 KCP_BENCH_STORE_MUTS=1500 \
     python bench.py --store | tail -1)
@@ -398,7 +429,7 @@ echo "== scenarios: seeded end-to-end chaos smoke (churn + reconnect storm + kil
 # files; the full catalog (incl. rolling-restart drain-vs-kill) runs
 # via `scripts/scenarios.py run --all --seed 42`.
 JAX_PLATFORMS=cpu python scripts/scenarios.py run \
-    --scenarios crud-churn,reconnect-storm,kill-primary,ring-change-under-load,scale-out-under-load \
+    --scenarios crud-churn,reconnect-storm,kill-primary,ring-change-under-load,scale-out-under-load,partition-during-promotion \
     --seed 42 --scale 0.4 --out SCENARIOS_smoke.json
 python -c '
 import json

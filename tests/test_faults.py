@@ -1014,3 +1014,96 @@ def test_commit_window_sync_failure_is_typed_5xx_over_http(tmp_path,
     st = walreplay.replay(str(tmp_path / "srv" / "store.wal"))
     names = {key.decode().split("\x00")[3] for key in st.objects}
     assert names == {"survivor"}
+
+
+# ---------------------------------------------------------------------------
+# WAN link realism: peer-pair-scoped partition + delay, fleet solve drill
+# ---------------------------------------------------------------------------
+
+
+def test_link_partition_drill_directed_cut_then_heal():
+    """link.partition:drop cuts ONLY the named directed pair; the heal
+    counter advances on every invocation of the point, so traffic on the
+    healthy reverse direction burns the partition down too."""
+    faults.install(faults.FaultInjector(
+        "link.partition:drop@peer=zone-a>10.0.0.2:6443@heal=3", seed=0))
+    with pytest.raises(ConnectionError):
+        faults.link_fault("zone-a", "10.0.0.2:6443")       # invocation 1
+    # reverse direction untouched (directed spec), but counts as inv 2
+    assert faults.link_fault("10.0.0.2:6443", "zone-a") == 0.0
+    # invocation 3 >= heal=3: the partition has healed
+    assert faults.link_fault("zone-a", "10.0.0.2:6443") == 0.0
+    assert counter("fault_injected_link_partition_total") >= 1
+
+
+def test_link_partition_bidirectional_wildcard_cut():
+    faults.install(faults.FaultInjector(
+        "link.partition:drop@peer=*<>standby", seed=0))
+    for src, dst in (("primary", "standby"), ("standby", "primary")):
+        with pytest.raises(ConnectionError):
+            faults.link_fault(src, dst)
+    # pairs not involving the standby stay connected
+    assert faults.link_fault("primary", "witness") == 0.0
+
+
+def test_link_delay_drill_seeded_wan_latency_with_jitter():
+    """link.delay:latency on a peer pair returns base+jitter seconds,
+    replayable per seed; other pairs ride free."""
+    spec = "link.delay:latency=50ms@peer=repl.feed>replica@jitter=20ms"
+    a = faults.FaultInjector(spec, seed=42)
+    b = faults.FaultInjector(spec, seed=42)
+    da = [a.link_delay("link.delay", "repl.feed", "replica")
+          for _ in range(8)]
+    db = [b.link_delay("link.delay", "repl.feed", "replica")
+          for _ in range(8)]
+    assert da == db                       # seeded => replayable
+    assert all(0.05 <= d <= 0.07 for d in da)
+    assert a.link_delay("link.delay", "repl.feed", "other") == 0.0
+
+
+def test_fleet_solve_fault_drill_requeues_then_converges():
+    """fleet.solve:error on the first dispatch: the scheduler requeues
+    the dirty rows (last good assignment stands — here: none yet) and
+    the retry converges to the weighted split."""
+    from kcp_tpu.apis import cluster as capi
+    from kcp_tpu.fleet.scheduler import FleetScheduler
+
+    async def main():
+        store = LogicalStore()
+        mc = MultiClusterClient(store)
+        t = mc.cluster_client("t")
+        for name, cap in (("big", 300), ("small", 100)):
+            obj = capi.new_cluster(name, kubeconfig=f"fake://{name}")
+            capi.set_capacity(obj, cap)
+            set_ready(obj)
+            t.create(capi.CLUSTERS, obj)
+        splitter = DeploymentSplitter(mc, backend="host")
+        sched = FleetScheduler(splitter)
+        faults.install(faults.FaultInjector("fleet.solve:error@tick=1",
+                                            seed=0))
+        await splitter.start()
+        await sched.start()
+        t.create(DEPLOYMENTS, deployment_obj("web", 12))
+        for _ in range(500):
+            try:
+                if t.get(DEPLOYMENTS, "web--big",
+                         "default")["spec"]["replicas"] == 9:
+                    break
+            except NotFoundError:
+                pass
+            await asyncio.sleep(0.01)
+        assert t.get(DEPLOYMENTS, "web--big",
+                     "default")["spec"]["replicas"] == 9
+        assert t.get(DEPLOYMENTS, "web--small",
+                     "default")["spec"]["replicas"] == 3
+        await sched.stop()
+        await splitter.stop()
+
+    def deployment_obj(name, replicas):
+        return {"apiVersion": "apps/v1", "kind": "Deployment",
+                "metadata": {"name": name, "namespace": "default"},
+                "spec": {"replicas": replicas,
+                         "template": {"spec": {"containers": []}}}}
+
+    asyncio.run(main())
+    assert counter("fault_injected_fleet_solve_total") >= 1

@@ -223,3 +223,64 @@ def test_fused_placement_apply_failure_retries_from_cache():
         await splitter.stop()
 
     asyncio.run(main())
+
+
+def test_flap_inside_hysteresis_is_zero_churn_and_replans_touch_one_workspace():
+    """A Ready flap inside the evacuation window moves NOTHING (no
+    resolves, no churn), and a sustained outage replans only the flapped
+    cluster's workspace — the other tenant's leafs are never rewritten."""
+    from kcp_tpu.apis.cluster import (CLUSTERS, REASON_SYNCER_NOT_READY,
+                                      set_not_ready, set_ready)
+    from kcp_tpu.utils.trace import REGISTRY
+
+    async def main():
+        store = LogicalStore()
+        mc = MultiClusterClient(store)
+        t1, t2 = mc.cluster_client("t1"), mc.cluster_client("t2")
+        for t, names in ((t1, ("east", "west")), (t2, ("solo",))):
+            for name in names:
+                obj = new_cluster(name)
+                set_ready(obj)
+                t.create(CLUSTERS, obj)
+        splitter = DeploymentSplitter(mc, evac_hysteresis=0.3)
+        await splitter.start()
+        t1.create(DEPLOYMENTS, deployment("web", 8))
+        t1.create(DEPLOYMENTS, deployment("api", 4))
+        t2.create(DEPLOYMENTS, deployment("db", 2))
+        await eventually(lambda: t1.get(DEPLOYMENTS, "web--east", "default"))
+        await eventually(lambda: t2.get(DEPLOYMENTS, "db--solo", "default"))
+
+        def flip(ready):
+            obj = t1.get(CLUSTERS, "east")
+            if ready:
+                set_ready(obj)
+            else:
+                set_not_ready(obj, REASON_SYNCER_NOT_READY, "flap")
+            t1.update_status(CLUSTERS, obj)
+
+        resolves0 = REGISTRY.counter("placement_resolves_total").value
+        churn0 = REGISTRY.counter("placement_churn_total").value
+        other_rv = t2.get(DEPLOYMENTS, "db--solo",
+                          "default")["metadata"]["resourceVersion"]
+
+        # flap: NotReady then Ready again inside the 0.3s window
+        flip(False)
+        await asyncio.sleep(0.1)
+        flip(True)
+        await asyncio.sleep(0.5)  # past the window: the check found Ready
+        assert REGISTRY.counter("placement_resolves_total").value == resolves0
+        assert REGISTRY.counter("placement_churn_total").value == churn0
+
+        # sustained: ONLY t1's two roots re-resolve; t2's leaf untouched
+        flip(False)
+        await eventually(lambda: t1.get(
+            DEPLOYMENTS, "web--west", "default")["spec"]["replicas"] == 8)
+        await eventually(lambda: t1.get(
+            DEPLOYMENTS, "api--west", "default")["spec"]["replicas"] == 4)
+        assert (REGISTRY.counter("placement_resolves_total").value
+                - resolves0) == 2
+        assert t2.get(DEPLOYMENTS, "db--solo",
+                      "default")["metadata"]["resourceVersion"] == other_rv
+        await splitter.stop()
+
+    asyncio.run(main())
