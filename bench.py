@@ -3117,6 +3117,192 @@ def replica_bench() -> int:
     return 0
 
 
+def consistent_bench() -> int:
+    """Consistent-read A/B (``--consistent``, the ``--replica`` lane's
+    KEP-2340 growth): read capacity when every read must be *consistent*
+    (no staler than the issuing session's own writes), primary-pinned vs
+    RV-barrier reads spread over the replicas at matched freshness. One
+    JSON line; ``value`` is the consistent-read capacity speedup at 2
+    replicas vs the primary-only pin.
+
+    Riders: (1) wait-for-frontier latency — under an active
+    ``repl.ship`` delay, write on the primary then immediately read the
+    replica pinned to the write's RV; p50/p99 of the observed barrier
+    park (the consistent read's freshness cost, vs the replica lane's
+    raw visibility lag). (2) session read-your-writes through the
+    router — every read of the session's own write must come back fresh
+    (zero stale), with a replica-local share high enough to prove the
+    barrier parks instead of falling back. (3) byte equality — the
+    replica's consistent list bytes sha256-equal the primary's at the
+    same RV (encode-once on both sides)."""
+    import hashlib
+
+    from kcp_tpu import faults
+    from kcp_tpu.server.rest import MultiClusterRestClient, RestClient
+    from kcp_tpu.server.server import Config
+    from kcp_tpu.server.threaded import ServerThread
+    from kcp_tpu.utils.trace import REGISTRY
+
+    objects = int(os.environ.get("KCP_BENCH_CONS_OBJECTS", "2000"))
+    seconds = float(os.environ.get("KCP_BENCH_CONS_SECONDS", "1.0"))
+    n_replicas = int(os.environ.get("KCP_BENCH_CONS_REPLICAS", "2"))
+    lag_writes = int(os.environ.get("KCP_BENCH_CONS_LAG_WRITES", "120"))
+    rywr_steps = int(os.environ.get("KCP_BENCH_CONS_RYWR_STEPS", "120"))
+    clusters = [f"t{i}" for i in range(8)]
+
+    def cm(name: str, cluster: str, data: str = "") -> dict:
+        return {"apiVersion": "v1", "kind": "ConfigMap",
+                "metadata": {"name": name, "namespace": "default",
+                             "clusterName": cluster}, "data": {"v": data}}
+
+    def status(address: str) -> dict:
+        c = RestClient(address)
+        try:
+            return c._request("GET", "/replication/status")
+        finally:
+            c.close()
+
+    def wait_applied(address: str, rv: int, timeout: float = 60.0) -> None:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if status(address)["applied_rv"] >= rv:
+                return
+            time.sleep(0.02)
+        raise RuntimeError(f"replica {address} never reached rv {rv}")
+
+    def read_rate(address: str, target: str, secs: float,
+                  headers: dict | None = None) -> float:
+        c = RestClient(address)
+        try:
+            c.request_raw("GET", target, headers=headers)  # warm
+            n = 0
+            t0 = time.perf_counter()
+            stop = t0 + secs
+            while time.perf_counter() < stop:
+                s, _h, _b = c.request_raw("GET", target, headers=headers)
+                assert s == 200, s
+                n += 1
+            return n / (time.perf_counter() - t0)
+        finally:
+            c.close()
+
+    primary = ServerThread(Config(durable=False, install_controllers=False,
+                                  tls=False)).start()
+    replicas = [ServerThread(Config(
+        durable=False, install_controllers=False, tls=False,
+        role="replica", primary=primary.address)).start()
+        for _ in range(n_replicas)]
+    router = ServerThread(Config(
+        role="router", durable=False, tls=False,
+        shards="s0=" + "|".join(
+            [primary.address] + [r.address for r in replicas]))).start()
+    out: dict = {}
+    try:
+        pc = MultiClusterRestClient(primary.address)
+        for i in range(objects):
+            pc.create("configmaps", cm(f"seed{i}", clusters[i % 8], str(i)))
+        seed_rv = int(status(primary.address)["applied_rv"])
+        for r in replicas:
+            wait_applied(r.address, seed_rv)
+        target = "/clusters/t0/api/v1/namespaces/default/configmaps"
+        pin = {"X-Kcp-Min-Rv": str(seed_rv)}
+
+        # --- capacity A/B at matched freshness (every read carries the
+        # session pin; the primary IS the frontier, replicas barrier) ---
+        per_slice = max(0.25, seconds / (n_replicas + 1))
+        primary_pinned = read_rate(primary.address, target, per_slice,
+                                   headers=pin)
+        spread = primary_pinned + sum(
+            read_rate(r.address, target, per_slice, headers=pin)
+            for r in replicas)
+        speedup = round(spread / max(primary_pinned, 1e-9), 2)
+
+        # --- byte equality at the same RV (sha256 rider) ---
+        c0 = RestClient(primary.address)
+        _s, _h, pb = c0.request_raw("GET", target)
+        c0.close()
+        digest = hashlib.sha256(pb).hexdigest()
+        bytes_equal = True
+        for r in replicas:
+            _s, rb = 0, b""
+            cr = RestClient(r.address)
+            _s, _h, rb = cr.request_raw("GET", target, headers=pin)
+            cr.close()
+            if hashlib.sha256(rb).hexdigest() != digest:
+                bytes_equal = False
+
+        # --- wait-for-frontier latency under a real ship delay ---
+        faults.install(faults.FaultInjector("repl.ship:latency=5ms",
+                                            seed=20260807))
+        rep = replicas[0]
+        waits_ms: list[float] = []
+        rc = RestClient(rep.address)
+        one = "/clusters/t1/api/v1/namespaces/default/configmaps"
+        for i in range(lag_writes):
+            w = pc.create("configmaps", cm(f"lag{i}", "t1", str(i)))
+            rv = w["metadata"]["resourceVersion"]
+            t0 = time.perf_counter()
+            s, _h, _b = rc.request_raw(
+                "GET", one, headers={"X-Kcp-Min-Rv": str(rv)})
+            waits_ms.append((time.perf_counter() - t0) * 1e3)
+            assert s == 200, s
+        rc.close()
+
+        # --- session read-your-writes through the router ---
+        reads_before = REGISTRY.counter("router_replica_reads_total").value
+        fb_before = REGISTRY.counter("router_replica_fallback_total").value
+        sc = RestClient(router.address, cluster="t2")
+        stale = 0
+        for i in range(rywr_steps):
+            sc.create("configmaps", cm(f"rw{i}", "t2", str(i)))
+            got = sc.get("configmaps", f"rw{i}", "default")
+            if got["data"]["v"] != str(i):
+                stale += 1
+        sc.close()
+        faults.clear()
+        replica_reads = (REGISTRY.counter(
+            "router_replica_reads_total").value - reads_before)
+        fallbacks = (REGISTRY.counter(
+            "router_replica_fallback_total").value - fb_before)
+        replica_local = round(
+            replica_reads / max(replica_reads + fallbacks, 1), 3)
+
+        import numpy as _np
+
+        out = {
+            "metric": "consistent_read_capacity_speedup",
+            "value": speedup,
+            "unit": "x",
+            "stage": "consistent-bench",
+            "consistent_bench": {
+                "host_cpus": os.cpu_count(), "objects": objects,
+                "replicas": n_replicas,
+                "capacity_rps": {"primary_pinned": round(primary_pinned, 1),
+                                 "spread": round(spread, 1)},
+                "capacity_speedup": speedup,
+                "bytes_equal": bytes_equal,
+                "list_sha256": digest[:16],
+                "wait_for_frontier": {
+                    "p50_ms": round(float(_np.percentile(waits_ms, 50)), 3),
+                    "p99_ms": round(float(_np.percentile(waits_ms, 99)), 3),
+                    "writes": len(waits_ms)},
+                "read_your_writes": {
+                    "reads": rywr_steps, "stale": stale,
+                    "replica_local_share": replica_local,
+                    "fallbacks": int(fallbacks)},
+            },
+        }
+        pc.close()
+    finally:
+        faults.clear()
+        router.stop()
+        for r in replicas:
+            r.stop()
+        primary.stop()
+    emit(out)
+    return 0
+
+
 def writes_bench() -> int:
     """Write-path group commit A/B (``--writes``): serial
     (``KCP_GROUP_COMMIT=0``) vs grouped (``=1``) at 1/16/64/256
@@ -4613,6 +4799,7 @@ if __name__ == "__main__":
         sys.exit(watchers_serve())
     if ("--store" in args or "--admission" in args or "--encode" in args
             or "--sharded" in args or "--replica" in args
+            or "--consistent" in args
             or "--watchers" in args or "--trace" in args
             or "--smartclient" in args or "--writes" in args
             or "--elastic" in args or "--pagination" in args
@@ -4629,6 +4816,7 @@ if __name__ == "__main__":
                  else admission_bench() if "--admission" in args
                  else sharded_bench() if "--sharded" in args
                  else replica_bench() if "--replica" in args
+                 else consistent_bench() if "--consistent" in args
                  else watchers_bench() if "--watchers" in args
                  else trace_bench() if "--trace" in args
                  else smartclient_bench() if "--smartclient" in args

@@ -72,8 +72,10 @@ _REFRESH = REGISTRY.counter(
     "successful GET /ring fetches (initial + staleness-triggered)")
 
 #: direct-path triggers that mean "the ring may be stale / the shard is
-#: not servable": refresh the ring and take the router hop this once
-_FALLBACK_STATUSES = (410, 503)
+#: not servable": refresh the ring and take the router hop this once.
+#: 504 is a follower's RV-barrier timeout (FrontierWaitTimeout): the
+#: router hop reaches the primary, which IS the frontier.
+_FALLBACK_STATUSES = (410, 503, 504)
 
 
 def smart_enabled() -> bool:
@@ -318,7 +320,9 @@ class SmartRestClient(RestClient):
         return RestWatch(host, port, routed._path, routed.resource,
                          token=self.token, ssl_context=pool.ssl_context,
                          extra_headers={RING_EPOCH_HEADER: str(epoch)},
-                         initial_events=initial_events)
+                         initial_events=initial_events,
+                         session=self._session,
+                         session_cluster=self.cluster)
 
     # ---------------------------------------------------------- lifecycle
 

@@ -105,6 +105,14 @@ class ReplicationApplier:
         self._task: asyncio.Task | None = None
         self._fence_task: asyncio.Task | None = None
         self._stopped = False
+        # RV-barrier waiters (KEP-2340 consistent reads): rv -> shared
+        # future resolved when applied_rv reaches it. Same coalescing
+        # discipline as the hub's semi-sync waiters.
+        self._barrier_futs: dict[int, asyncio.Future] = {}
+        # recent apply throughput (records/s, EWMA over feed batches) —
+        # the denominator of the lag-shed Retry-After hint
+        self._apply_rate = 0.0
+        self._rate_t0 = 0.0
         self._set_primary(self.candidates[0])
         self._rehomes = REGISTRY.counter(
             "repl_rehome_total",
@@ -120,6 +128,10 @@ class ReplicationApplier:
         self._applied_total = REGISTRY.counter(
             "repl_apply_records_total",
             "WAL records applied from the replication feed")
+        self._frontier_gauge = REGISTRY.gauge(
+            "repl_frontier_rv",
+            "primary's commit RV as last seen by this follower (stream "
+            "header, records, or PROGRESS heartbeats)")
 
     def _set_primary(self, url: str) -> None:
         """Point the feed/probe/ack/fence plumbing at ``url`` (the
@@ -151,11 +163,83 @@ class ReplicationApplier:
     def lag_records(self) -> int:
         return max(0, self.last_seen_rv - self.store.resource_version)
 
+    @property
+    def frontier_rv(self) -> int:
+        """The primary's commit frontier as last observed (header, WAL
+        records, or PROGRESS heartbeats on an idle feed)."""
+        return max(self.last_seen_rv, self.store.resource_version)
+
+    @property
+    def apply_rate(self) -> float:
+        """Recent apply throughput in records/s (0.0 until measured)."""
+        return self._apply_rate
+
+    async def wait_applied(self, rv: int, timeout_s: float) -> bool:
+        """RV-barrier for consistent reads: park until this follower's
+        applied RV reaches ``rv`` or ``timeout_s`` expires. Waiters at
+        the same RV share one future (the hub semi-sync discipline).
+        True when the barrier is satisfied; False on timeout — the
+        caller answers the typed 504 and the read falls back to the
+        primary.
+
+        Fast-fail: when ``rv`` is above the frontier AND the feed is
+        down, no in-flight record can ever satisfy the barrier — the
+        progress-notify frontier is exactly the proof that this
+        follower has never even seen the RV. Parking the full window
+        would only slow the caller's fallback (a dead primary mid
+        failover would turn every pinned read into a full timeout)."""
+        if self.store.resource_version >= rv or self.promoted:
+            return True
+        if rv > self.frontier_rv and not self.connected:
+            return False
+        # reachability: the EWMA apply rate bounds how far this
+        # follower can catch up inside the window — a barrier that is
+        # provably out of reach (2x slack for bursty batches) answers
+        # immediately too. A wrong fast-fail only costs one primary
+        # read; a doomed park costs the caller the whole window on
+        # every read while the follower is drowning
+        rate = self._apply_rate
+        if rate > 0.0 and (rv - self.store.resource_version) \
+                > rate * timeout_s * 2.0:
+            return False
+        fut = self._barrier_futs.get(rv)
+        if fut is None or fut.done():
+            fut = asyncio.get_running_loop().create_future()
+            self._barrier_futs[rv] = fut
+        try:
+            # shield: the shared future must survive one reader's timeout
+            await asyncio.wait_for(asyncio.shield(fut), timeout=timeout_s)
+            # releases fire on apply, promote, AND stop: re-check rather
+            # than trusting the future (a stop-path release must not
+            # pretend the barrier was reached)
+            return self.store.resource_version >= rv or self.promoted
+        except asyncio.TimeoutError:
+            return self.store.resource_version >= rv
+
+    def _release_barriers(self) -> None:
+        if not self._barrier_futs:
+            return
+        applied = self.store.resource_version
+        for rv in [r for r, f in self._barrier_futs.items()
+                   if r <= applied or f.done()]:
+            fut = self._barrier_futs.pop(rv)
+            if not fut.done():
+                fut.set_result(True)
+
+    def _release_all_barriers(self) -> None:
+        """Promotion/shutdown: nothing will ever apply again on this
+        path — release every parked reader (they re-check applied_rv)."""
+        for fut in self._barrier_futs.values():
+            if not fut.done():
+                fut.set_result(True)
+        self._barrier_futs.clear()
+
     async def start(self) -> None:
         self._task = asyncio.ensure_future(self._run())
 
     async def stop(self) -> None:
         self._stopped = True
+        self._release_all_barriers()
         for t in (self._task, self._fence_task):
             if t is not None:
                 t.cancel()
@@ -359,6 +443,13 @@ class ReplicationApplier:
                         in_snapshot = False
                         self.store.finish_resync(int(m["rv"]))
                         applied += 1
+                    elif mtype == "PROGRESS":
+                        # bodyless frontier heartbeat: the primary is
+                        # quiet but alive — advance the frontier so
+                        # repl_lag stays honest between records and
+                        # RV-barrier reads can resolve "consistent"
+                        self.last_seen_rv = max(self.last_seen_rv,
+                                                int(m.get("rv", 0)))
                     elif mtype == "ERROR":
                         obj = m.get("object") or {}
                         raise _status_error(obj.get("code", 410),
@@ -383,8 +474,18 @@ class ReplicationApplier:
                                 {"rv": str(rv), "role": self.role})
                 if applied:
                     self._applied_total.inc(applied)
+                    now = time.monotonic()
+                    if self._rate_t0:
+                        dt = max(1e-6, now - self._rate_t0)
+                        inst = applied / dt
+                        self._apply_rate = (
+                            inst if self._apply_rate == 0.0
+                            else 0.7 * self._apply_rate + 0.3 * inst)
+                    self._rate_t0 = now
                 self._applied_gauge.set(self.store.resource_version)
                 self._lag_gauge.set(self.lag_records)
+                self._frontier_gauge.set(self.frontier_rv)
+                self._release_barriers()
                 if applied and not in_snapshot and self.role == "standby" \
                         and self._sub_id is not None:
                     await self._send_ack()
@@ -437,6 +538,7 @@ class ReplicationApplier:
         self.store.fenced = False
         self.store.reject_future_rv = False
         self.promoted = True
+        self._release_all_barriers()
         REGISTRY.counter(
             "repl_promotions_total",
             "standby promotions to primary").inc()

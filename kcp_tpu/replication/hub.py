@@ -39,12 +39,23 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import os
 from collections import deque
 
 from ..faults import link_fault, maybe_fail
 from ..utils.trace import REGISTRY
 
 log = logging.getLogger(__name__)
+
+
+def _progress_notify_s() -> float:
+    """Progress-notify cadence (etcd WatchProgressRequest analog) in
+    seconds; 0 disables heartbeats entirely."""
+    try:
+        ms = float(os.environ.get("KCP_PROGRESS_NOTIFY_MS", "500") or 0)
+    except ValueError:
+        ms = 500.0
+    return max(0.0, ms / 1000.0)
 
 
 class _Sub:
@@ -90,6 +101,11 @@ class ReplicationHub:
             "semi-sync waiters that parked on an already-waiting commit "
             "window RV — writes released by a shared standby ack instead "
             "of their own round trip")
+        self._progress = REGISTRY.counter(
+            "repl_progress_notify_total",
+            "PROGRESS heartbeat frames shipped on idle replication feeds "
+            "(no record body, just the primary's commit RV) so quiet "
+            "followers know the frontier")
         store.set_repl_hook(self.commit, self.commit_batch)
 
     # ------------------------------------------------------------- commit
@@ -299,8 +315,31 @@ class ReplicationHub:
                 # hands them to the transport with no whole-batch join
                 await stream.send_spans(tail)
                 self._shipped.inc(len(tail))
+            notify_s = _progress_notify_s()
             while True:
-                line = await sub.q.get()
+                if notify_s:
+                    try:
+                        line = await asyncio.wait_for(sub.q.get(), notify_s)
+                    except asyncio.TimeoutError:
+                        # feed idle past the progress cadence: ship a
+                        # bodyless frontier heartbeat so the follower can
+                        # answer RV-barrier reads without a fresh record.
+                        # NOT appended to _records — heartbeats must never
+                        # occupy the RV-resume window.
+                        hb = json.dumps(
+                            {"type": "PROGRESS",
+                             "epoch": self.store.epoch,
+                             "rv": self.store.resource_version},
+                            separators=(",", ":")).encode() + b"\n"
+                        delay = maybe_fail("repl.ship")
+                        delay += link_fault("repl.feed", role or "replica")
+                        if delay:
+                            await asyncio.sleep(delay)
+                        await stream.send_spans([hb])
+                        self._progress.inc()
+                        continue
+                else:
+                    line = await sub.q.get()
                 batch = [line]
                 while not sub.q.empty():
                     batch.append(sub.q.get_nowait())

@@ -390,7 +390,14 @@ WAN_REPLICA_LAG = ScenarioSpec(
                 "ONLY that follower (the semi-sync standby acks at LAN "
                 "speed, so client acks never slow), and once the link "
                 "heals the replica must drain its lag to zero — "
-                "bounded staleness, not silent divergence.",
+                "bounded staleness, not silent divergence. Session-"
+                "consistency probers ride alongside the writers, every "
+                "read pinned to the tenant's own max acked RV "
+                "(X-Kcp-Min-Rv): whichever node answers through the "
+                "lagging link — parked on its RV barrier or fallen "
+                "back to the primary — the response must never come "
+                "back below the session floor, with zero surfaced "
+                "errors.",
     topology="replicated",
     tenants=5,
     watchers_per_tenant=1,
@@ -400,7 +407,8 @@ WAN_REPLICA_LAG = ScenarioSpec(
                          "@peer=repl.feed>replica",
                   settle_s=1.0),
             Phase("drain", ops_per_tenant=20, settle_s=2.0)),
-    options={"pace_s": 0.02, "coverage_timeout_s": 30.0},
+    options={"pace_s": 0.02, "coverage_timeout_s": 30.0,
+             "consistent_readers": True},
     slos=(
         SLO("no-lost-acked-writes", "lost_acked_writes", "==", 0),
         SLO("wan-delay-actually-fired",
@@ -410,6 +418,13 @@ WAN_REPLICA_LAG = ScenarioSpec(
         SLO("no-spurious-promotion", "repl_promotions", "==", 0),
         SLO("no-lost-watch-events", "lost_watch_events", "==", 0),
         SLO("error-budget-5xx", "http_5xx", "==", 0),
+        SLO("consistent-reads-served", "consistent_reads", ">=", 1),
+        SLO("zero-stale-consistent-reads",
+            "stale_consistent_reads", "==", 0),
+        SLO("zero-consistent-read-errors",
+            "consistent_read_errors", "==", 0),
+        SLO("barrier-parked-under-lag",
+            "consistent_read_waits", ">=", 1),
     ),
 )
 

@@ -26,6 +26,7 @@ import asyncio
 import logging
 import math
 import os
+import threading
 import time
 
 from .. import faults as faults_mod
@@ -41,6 +42,7 @@ from .workload import (
     WriterStats,
     build_schedule,
     expected_final_state,
+    run_consistent_reader,
     run_flood,
     run_writer,
     schedule_hash,
@@ -64,7 +66,11 @@ TRACKED_COUNTERS = ("repl_promotions_total", "repl_rehome_total",
                     "placement_resolves_total",
                     "placement_churn_total",
                     "cluster_evacuations_total",
-                    "cluster_readmissions_total")
+                    "cluster_readmissions_total",
+                    "consistent_read_waits_total",
+                    "consistent_read_timeouts_total",
+                    "router_replica_reads_total",
+                    "router_replica_fallback_total")
 
 
 def pctile(vals: list[float], q: float) -> float:
@@ -328,6 +334,21 @@ async def _drive(sspec: ScenarioSpec, seed: int, schedule, topology,
                                 ops, stats, phase.name, "quiet", 30.0,
                                 pace,
                                 smart_all or (smart_half and ti % 2 == 0)))
+                reader_futs = []
+                reader_stop = threading.Event()
+                if sspec.options.get("consistent_readers"):
+                    # session-consistency probers ride alongside the
+                    # writers: every read pins the tenant's own max
+                    # acked RV — a lagging replica must park, fall
+                    # back, or refuse, never answer below the floor
+                    shared = measurements.setdefault("_consistent", {
+                        "_lock": threading.Lock(), "consistent_reads": 0,
+                        "stale_consistent_reads": 0,
+                        "consistent_read_errors": 0})
+                    for ti in range(sspec.tenants):
+                        reader_futs.append(loop.run_in_executor(
+                            None, run_consistent_reader, base,
+                            tenant_name(ti), stats, shared, reader_stop))
                 flood_fut = None
                 if phase.action == "flood":
                     flood_fut = loop.run_in_executor(
@@ -346,7 +367,11 @@ async def _drive(sspec: ScenarioSpec, seed: int, schedule, topology,
                     measurements["flood_429"] = throttled
                 if action_fut is not None:
                     await action_fut
+                if reader_futs:
+                    reader_stop.set()
+                    await asyncio.gather(*reader_futs)
             finally:
+                reader_stop.set()
                 if inj is not None:
                     faults_mod.clear()
             traces = await loop.run_in_executor(
@@ -552,7 +577,7 @@ def _collect(sspec: ScenarioSpec, stats: WriterStats, observers,
     # fleet/placement workload measurements: the driver's shared dict
     # holds scratch state (_-prefixed) AND final numbers — fold only
     # the numbers, under their final metric names
-    for key in ("_fleet", "_placement"):
+    for key in ("_fleet", "_placement", "_consistent"):
         drv_shared = m.pop(key, None)
         if drv_shared is not None:
             m.update({k: v for k, v in drv_shared.items()

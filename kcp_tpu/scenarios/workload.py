@@ -265,6 +265,59 @@ def run_writer(base_url: str, tenant: str, ops: list[Op], stats: WriterStats,
         c.close()
 
 
+def run_consistent_reader(base_url: str, tenant: str, stats: WriterStats,
+                          shared: dict, stop: threading.Event,
+                          pace_s: float = 0.01) -> None:
+    """Session-consistency prober (a blocking worker thread): reads the
+    tenant's collection through the scenario's client endpoint with the
+    session's own write floor pinned (``X-Kcp-Min-Rv`` = the tenant's
+    max acked RV). Whichever node answers — the primary, the standby,
+    or a WAN-lagged replica parked on its RV barrier — the response's
+    list RV must never fall below the floor; a response that does is a
+    stale read-your-write, the thing the consistent-read SLOs forbid.
+    Counts fold into ``shared`` (``consistent_reads`` /
+    ``stale_consistent_reads`` / ``consistent_read_errors``)."""
+    c = RestClient(base_url, cluster=tenant)
+    lock = shared["_lock"]
+    target = f"/clusters/{tenant}/api/v1/namespaces/{NAMESPACE}/{RESOURCE}"
+    try:
+        while not stop.is_set():
+            if pace_s:
+                time.sleep(pace_s)
+            with stats._lock:
+                floor = stats.max_rv.get(tenant, 0)
+            if not floor:
+                continue
+            ok = stale = err = 0
+            for attempt in range(3):
+                try:
+                    s, _h, body = c.request_raw(
+                        "GET", target,
+                        headers={"X-Kcp-Min-Rv": str(floor)})
+                except (ConnectionError, OSError, errors.ApiError):
+                    s, body = 0, b""
+                if s == 200:
+                    rv = int(json.loads(body)["metadata"]
+                             .get("resourceVersion", "0"))
+                    if rv >= floor:
+                        ok = 1
+                    else:
+                        stale = 1
+                    break
+                # transport hiccup or relayed 5xx: the router's fallback
+                # should have absorbed it — brief retry before counting
+                # a surfaced error against the zero-error SLO
+                time.sleep(0.1)
+            else:
+                err = 1
+            with lock:
+                shared["consistent_reads"] += ok
+                shared["stale_consistent_reads"] += stale
+                shared["consistent_read_errors"] += err
+    finally:
+        c.close()
+
+
 def run_flood(base_url: str, tenant: str, n_ops: int,
               stats: WriterStats) -> tuple[int, int]:
     """The noisy neighbor: fire creates as fast as the wire allows; no

@@ -148,6 +148,20 @@ class RestHandler:
         self.repl_applier = None
         self.repl_role = "primary"
         self.repl_lag_max = 0
+        # KEP-2340 consistent reads: replica-side RV-barrier telemetry
+        self._consistent_waits = REGISTRY.counter(
+            "consistent_read_waits_total",
+            "replica reads that parked on the RV barrier because "
+            "applied_rv was behind the required RV")
+        self._consistent_timeouts = REGISTRY.counter(
+            "consistent_read_timeouts_total",
+            "RV-barrier reads that hit KCP_CONSISTENT_READ_TIMEOUT_MS "
+            "and answered the typed 504 (callers fall back to the "
+            "primary)")
+        self._consistent_wait_seconds = REGISTRY.histogram(
+            "consistent_read_wait_seconds",
+            "time an RV-barrier read waited for this follower to apply "
+            "its required RV")
         # group-commit admission batching: commit-window future -> the
         # enrolled writes' (quota reservation, after-hook) pairs; settled
         # in ONE ledger pass when the window resolves (_settle_adm_window)
@@ -611,6 +625,9 @@ class RestHandler:
             from ..apis.printers import render_table, wants_table
 
             self._check_replica_lag()
+            await self._consistent_read_gate(
+                req, watch=(name is None
+                            and req.param("watch") in ("true", "1")))
             as_table = wants_table(req.headers.get("accept", ""))
             if name is None:
                 if req.param("watch") in ("true", "1"):
@@ -679,7 +696,9 @@ class RestHandler:
                 ticket.fail()
                 raise
             await self._finish_write(ticket)
-            return Response.of_json(self._stamp(created, info, gv), 201)
+            return self._rv_stamped(
+                Response.of_json(self._stamp(created, info, gv), 201),
+                (created.get("metadata") or {}).get("resourceVersion"))
 
         if req.method == "PUT" and name is not None:
             obj = self._body_object(req)
@@ -707,7 +726,9 @@ class RestHandler:
                 ticket.fail()
                 raise
             await self._finish_write(ticket)
-            return Response.of_json(self._stamp(updated, info, gv))
+            return self._rv_stamped(
+                Response.of_json(self._stamp(updated, info, gv)),
+                (updated.get("metadata") or {}).get("resourceVersion"))
 
         if req.method == "DELETE" and name is not None:
             target = await self._read_cluster(cluster, res, name, namespace)
@@ -725,10 +746,25 @@ class RestHandler:
                 ticket.fail()
                 raise
             await self._finish_write(ticket)
-            return Response.of_json(_status_body(200, "Deleted", f"{res} {name} deleted"))
+            # a delete's Status body carries no RV, but session
+            # read-your-writes needs a floor covering it: stamp the
+            # store RV (>= the delete's own RV) as a response header
+            rv = (0 if self._remote
+                  else getattr(self.store, "resource_version", 0))
+            return self._rv_stamped(
+                Response.of_json(_status_body(
+                    200, "Deleted", f"{res} {name} deleted")), rv)
 
         raise errors.BadRequestError(f"unsupported method {req.method} for {req.path}")
 
+    @staticmethod
+    def _rv_stamped(resp: Response, rv) -> Response:
+        """Mirror a write's committed RV as ``X-Kcp-Rv`` so clients can
+        raise their session read-your-writes floor without parsing the
+        body (delete acks are Status objects with no RV at all)."""
+        if rv:
+            resp.headers["X-Kcp-Rv"] = str(rv)
+        return resp
 
     @staticmethod
     def _body_object(req: Request) -> dict:
@@ -926,6 +962,8 @@ class RestHandler:
             ap = self.repl_applier
             if ap is not None:
                 body["lag_records"] = ap.lag_records
+                body["frontier_rv"] = ap.frontier_rv
+                body["apply_rate"] = round(ap.apply_rate, 3)
                 body["connected"] = ap.connected
                 body["primary"] = ap.primary_url
                 body["primary_candidates"] = list(ap.candidates)
@@ -1179,13 +1217,90 @@ class RestHandler:
     def _check_replica_lag(self) -> None:
         """Reads on a replica past KCP_REPL_LAG_MAX refuse 503 — for
         consumers that prefer unavailability over staleness; the
-        default (0) serves any staleness RV-honestly."""
+        default (0) serves any staleness RV-honestly. The refusal
+        carries a computed Retry-After (current lag / recent apply
+        rate) so informers back off exactly as long as catch-up needs
+        instead of a generic jittered retry."""
         ap = self.repl_applier
         if (self.repl_lag_max and ap is not None
                 and ap.lag_records > self.repl_lag_max):
-            raise errors.UnavailableError(
+            err = errors.UnavailableError(
                 f"replica lag {ap.lag_records} records exceeds "
                 f"KCP_REPL_LAG_MAX={self.repl_lag_max}; read the primary")
+            rate = getattr(ap, "apply_rate", 0.0)
+            err.retry_after = (min(30.0, max(1.0, ap.lag_records / rate))
+                               if rate > 0 else 1.0)
+            raise err
+
+    @staticmethod
+    def _consistent_timeout_s() -> float:
+        try:
+            ms = float(os.environ.get(
+                "KCP_CONSISTENT_READ_TIMEOUT_MS", "2000") or 0)
+        except ValueError:
+            ms = 2000.0
+        return max(0.0, ms / 1000.0)
+
+    async def _consistent_read_gate(self, req: Request,
+                                    watch: bool = False) -> None:
+        """KEP-2340 RV-barrier for reads on a follower: a read carrying
+        a required RV (``X-Kcp-Min-Rv: <rv>``, ``X-Kcp-Min-Rv:
+        consistent`` resolved against the progress-notify frontier, an
+        RV-pinned continue token, or a watch resume RV) parks on the
+        applier's bounded waiter until ``applied_rv >= required``, then
+        serves from the local store through the encode-once path —
+        byte-identical to the primary at that RV. Timeout answers the
+        typed 504 (:class:`~kcp_tpu.utils.errors.FrontierTimeoutError`)
+        and the caller falls back to the primary; a timed-out watch
+        resume instead falls through to the store's own
+        ``reject_future_rv`` answer (typed 410 → the client re-lists).
+        No-op on a primary: it IS the frontier."""
+        ap = self.repl_applier
+        if ap is None or ap.promoted:
+            return
+        raw = (req.headers.get("x-kcp-min-rv") or "").strip()
+        required = 0
+        if raw:
+            if raw.lower() == "consistent":
+                # one cheap frontier probe: the progress-notify stream
+                # keeps last_seen_rv fresh even on a quiet feed
+                required = ap.frontier_rv
+            else:
+                try:
+                    required = int(raw)
+                except ValueError:
+                    raise errors.BadRequestError(
+                        f"malformed X-Kcp-Min-Rv {raw!r}") from None
+        cont = req.param("continue")
+        if cont:
+            from ..store.store import decode_continue
+
+            try:
+                required = max(required, decode_continue(cont)[0])
+            except ValueError:
+                pass  # the page path answers the typed 410
+        since = req.param("resourceVersion")
+        if since:
+            # a watch resume RV or an RV-pinned list: both mean "the
+            # client has seen this RV" — the same barrier applies
+            try:
+                required = max(required, int(since))
+            except ValueError:
+                pass  # _watch raises the typed 400; lists ignore it
+        if required <= self.store.resource_version:
+            return
+        timeout_s = self._consistent_timeout_s()
+        self._consistent_waits.inc()
+        t0 = time.perf_counter()
+        ok = await ap.wait_applied(required, timeout_s)
+        self._consistent_wait_seconds.observe(time.perf_counter() - t0)
+        if ok or watch:
+            return
+        self._consistent_timeouts.inc()
+        raise errors.FrontierTimeoutError(
+            f"applied_rv {self.store.resource_version} < required "
+            f"{required} after {int(timeout_s * 1000)}ms; "
+            f"read the primary")
 
     # -------------------------------------------------------------- watch
 

@@ -50,6 +50,7 @@ def _status_error(code: int, reason: str, message: str,
         "Forbidden": errors.ForbiddenError,
         "TooManyRequests": errors.TooManyRequestsError,
         "ServiceUnavailable": errors.UnavailableError,
+        "FrontierWaitTimeout": errors.FrontierTimeoutError,
         "Expired": errors.GoneError,
         "Gone": errors.GoneError,
     }
@@ -60,7 +61,8 @@ def _status_error(code: int, reason: str, message: str,
                422: errors.InvalidError, 400: errors.BadRequestError,
                403: errors.ForbiddenError,
                429: errors.TooManyRequestsError,
-               503: errors.UnavailableError}.get(code, errors.ApiError)
+               503: errors.UnavailableError,
+               504: errors.FrontierTimeoutError}.get(code, errors.ApiError)
     err = cls(message)
     if cls is errors.ApiError and code >= 400:
         # codes without a dedicated class (401/...) keep their real
@@ -74,6 +76,15 @@ def _status_error(code: int, reason: str, message: str,
             err.retry_after = max(0.0, float(hint))
         except (TypeError, ValueError):
             pass  # class default (1.0) stands
+    elif isinstance(err, errors.UnavailableError):
+        # lag-shed 503s carry a computed Retry-After (replica lag /
+        # apply rate): informers back off exactly as long as catch-up
+        # needs instead of the generic jittered retry
+        hint = (details or {}).get("retryAfterSeconds", retry_after)
+        try:
+            err.retry_after = max(0.0, float(hint))
+        except (TypeError, ValueError):
+            pass  # no hint: callers keep their generic backoff
     return err
 
 
@@ -110,6 +121,60 @@ def _list_page_size() -> int:
         return 10000
 
 
+def _session_rv_enabled() -> bool:
+    """Session read-your-writes (KCP_SESSION_RV, default on): clients
+    track the max RV observed from their own write acks and watch
+    streams per cluster and stamp it as ``X-Kcp-Min-Rv`` on subsequent
+    reads — any replica then serves them no staler than the session's
+    own past (KEP-2340 consistent reads). ``0`` restores the plain
+    any-staleness read path."""
+    return os.environ.get("KCP_SESSION_RV", "1").lower() not in (
+        "0", "false", "off")
+
+
+def _path_cluster(path: str) -> str:
+    """The ``/clusters/<name>/`` tenant a request path targets; ""
+    for non-cluster paths and the wildcard — RVs are per-store
+    sequences, so a session floor is only meaningful against the one
+    cluster (= shard) that minted it."""
+    if not path.startswith("/clusters/"):
+        return ""
+    c = path[len("/clusters/"):].partition("/")[0].partition("?")[0]
+    return "" if c in ("", WILDCARD) else c
+
+
+class _SessionRv:
+    """Per-cluster session read-your-writes floor, SHARED across every
+    scoped() clone of one client (the holder object rides the
+    ``__dict__`` copy, like the smart client's ring state): the max RV
+    this session observed from its own write acks and watch streams.
+    Thread-safe — scenario writers and watch feed tasks update it
+    concurrently."""
+
+    def __init__(self):
+        self._lock = make_lock("rest.session")
+        self._floor: dict[str, int] = {}
+
+    def note(self, cluster: str, rv) -> None:
+        if not cluster:
+            return
+        try:
+            rv = int(rv)
+        except (TypeError, ValueError):
+            return
+        if rv <= 0:
+            return
+        with self._lock:
+            if rv > self._floor.get(cluster, 0):
+                self._floor[cluster] = rv
+
+    def floor(self, cluster: str) -> int:
+        if not cluster:
+            return 0
+        with self._lock:
+            return self._floor.get(cluster, 0)
+
+
 class RestWatch:
     """Async iterator over a server watch stream, yielding store Events.
 
@@ -121,6 +186,11 @@ class RestWatch:
     # class-level default so a skeletal instance (tests build one via
     # ``__new__`` to drive ``_feed`` directly) still parses bookmarks
     _initial_events = False
+    # session read-your-writes: when a _SessionRv rides along, every
+    # observed event/bookmark RV raises the session floor (class-level
+    # defaults keep skeletal __new__ instances working)
+    _session = None
+    _session_cluster = ""
     # source name for peer-scoped link faults (link.partition/link.delay);
     # the destination is the watched server's host:port
     link_src = "watch"
@@ -128,7 +198,8 @@ class RestWatch:
     def __init__(self, host: str, port: int, path: str, resource: str,
                  token: str = "", ssl_context=None,
                  extra_headers: dict[str, str] | None = None,
-                 initial_events: bool = False):
+                 initial_events: bool = False,
+                 session=None, session_cluster: str = ""):
         self._host = host
         self._port = port
         self._path = path
@@ -140,6 +211,8 @@ class RestWatch:
         # extra request headers (the smart client's X-Kcp-Ring-Epoch
         # stamp on direct-to-shard watches rides here)
         self._extra_headers = extra_headers or {}
+        self._session = session
+        self._session_cluster = session_cluster
         self.resource = resource
         self._events: asyncio.Queue[Event | None] = asyncio.Queue()
         self._task: asyncio.Task | None = None
@@ -277,6 +350,8 @@ class RestWatch:
                 self.last_rv = rv
             except ValueError:
                 rv = 0
+            if self._session is not None:
+                self._session.note(self._session_cluster, rv)
             if (self._initial_events and (meta.get("annotations") or {})
                     .get(INITIAL_EVENTS_END) == "true"):
                 self._events.put_nowait(Event(
@@ -288,6 +363,9 @@ class RestWatch:
         meta = obj.get("metadata") or {}
         rv = int(meta.get("resourceVersion", "0"))
         self.last_rv = max(self.last_rv, rv)
+        if self._session is not None:
+            self._session.note(self._session_cluster
+                               or meta.get("clusterName", ""), rv)
         self._events.put_nowait(Event(
             type=msg["type"],
             resource=self.resource,
@@ -406,6 +484,9 @@ class RestClient:
         # own 30s connect timeouts on the store-I/O executor
         self._breaker = CircuitBreaker(f"rest_{self._host}_{self._port}")
         self._conn: http.client.HTTPConnection | None = None
+        # session read-your-writes floor (KCP_SESSION_RV), shared across
+        # scoped() clones via the __dict__ copy; None when disabled
+        self._session = _SessionRv() if _session_rv_enabled() else None
 
     def scoped(self, cluster: str) -> "RestClient":
         # type(self), not RestClient: a subclass's scoped clones keep the
@@ -502,6 +583,15 @@ class RestClient:
         headers = {"Content-Type": "application/json"} if payload else {}
         if self.token:
             headers["Authorization"] = f"Bearer {self.token}"
+        if method == "GET" and self._session is not None:
+            # session read-your-writes: stamp the per-cluster floor so
+            # a replica serves this read no staler than the session's
+            # own writes/watch position (headers are built before
+            # _roundtrip, so the smart client's direct path rides this
+            # unchanged)
+            floor = self._session.floor(_path_cluster(path))
+            if floor:
+                headers["X-Kcp-Min-Rv"] = str(floor)
         tracer = obs.TRACER
         sub = t0 = None
         if tracer.enabled:
@@ -530,16 +620,30 @@ class RestClient:
             # X-Kcp-Ring-Epoch stamp must survive the raise so the smart
             # client's fallback sees them on the direct path too
             rheaders = {k.lower(): v for k, v in resp.getheaders()}
-            if status == 429:
-                # a throttling answer is the peer ALIVE (the breaker saw
-                # record_success above); surface the pacing hint instead
+            if status in (429, 503, 504):
+                # a throttling/shedding answer is the peer ALIVE (the
+                # breaker saw record_success above); surface the pacing
+                # hint instead
                 try:
                     retry_after = float(rheaders.get("retry-after") or "")
                 except ValueError:
                     pass
         _raise_for_status(status, data, retry_after=retry_after,
                           headers=rheaders)
-        return json.loads(data) if data else None
+        out = json.loads(data) if data else None
+        if (self._session is not None
+                and method in ("POST", "PUT", "DELETE")):
+            # raise the session floor from the write's committed RV:
+            # X-Kcp-Rv header (covers delete Status bodies), else the
+            # object's own metadata.resourceVersion
+            geth = getattr(resp, "getheaders", None)
+            rv = (next((v for k, v in geth()
+                        if k.lower() == "x-kcp-rv"), None)
+                  if geth is not None else None)
+            if rv is None and isinstance(out, dict):
+                rv = (out.get("metadata") or {}).get("resourceVersion")
+            self._session.note(_path_cluster(path), rv)
+        return out
 
     def request_raw(self, method: str, target: str,
                     payload: bytes | None = None,
@@ -714,7 +818,11 @@ class RestClient:
         path = self._path(res, namespace, query=query)
         return RestWatch(self._host, self._port, path, res, token=self.token,
                          ssl_context=self._ssl,
-                         initial_events=initial_events)
+                         initial_events=initial_events,
+                         session=self._session,
+                         session_cluster=(self.cluster
+                                          if self.cluster != WILDCARD
+                                          else ""))
 
     # ------------------------------------------------------------- writes
 

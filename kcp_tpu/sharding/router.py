@@ -511,14 +511,20 @@ class RouterHandler:
 
     async def _read_via_replica(self, idx: int, target: str,
                                 req: Request) -> Response:
-        """A plain single-cluster read, round-robined over the owning
-        shard's replicas; primary fallback when every replica is
-        unreachable or refusing (503: lag gate, mid-promotion)."""
+        """A single-cluster read, round-robined over the owning shard's
+        replicas; primary fallback when every replica is unreachable or
+        refusing. RV-carrying reads (X-Kcp-Min-Rv, continue tokens,
+        resourceVersion params) forward as-is — the replica's RV
+        barrier parks them until its applied RV covers the pin, so
+        they no longer burn the primary. Fallbacks are metered per
+        reason: 503 is the replica's lag shed, 504 its RV-barrier
+        timeout, transport/breaker failures mean it was unreachable."""
         pools = self._rpools[idx]
         n = len(pools)
         start = self._rr[idx] % n
         self._rr[idx] = (start + 1) % n
         headers = self._fwd_headers(req)
+        reasons: set[str] = set()
         for k in range(n):
             j = (start + k) % n
             who = f"{self.ring.shards[idx].name}/replica{j}"
@@ -527,27 +533,41 @@ class RouterHandler:
                     idx, "GET", target, None, headers,
                     pool=pools[j], who=who)
             except errors.UnavailableError:
+                reasons.add("breaker_open")
                 continue
             if status == 503:
+                reasons.add("lag_shed")
+                continue
+            if status == 504:
+                # the replica is healthy but behind the read's required
+                # RV and the bounded wait expired: the next replica may
+                # be caught up; otherwise the primary answers
+                reasons.add("consistent_timeout")
                 continue
             self._replica_reads.inc()
             return self._relay(status, h, body)
         self._replica_fallback.inc()
+        for r in ("consistent_timeout", "lag_shed", "breaker_open"):
+            if r in reasons:
+                REGISTRY.counter(
+                    f"router_replica_fallback_{r}_total").inc()
+                break
         status, h, body = await self._call(idx, "GET", target, None, headers)
         return self._relay(status, h, body)
 
     def _replica_watch_pool(self, idx: int,
                             req: Request) -> ConnectionPool | None:
-        """Where a FRESH single-cluster watch stream lands: fresh
-        watches (no resume RV) round-robin across the shard's primary
-        AND its replicas, so live watch connection count scales with
-        the replica count — a replica's stream is its own honest RV
-        sequence. Resumes carry an RV the client got from a
-        primary-coherent read, so they stay on the primary (a lagging
-        replica would answer 410 beyond its applied RV via
-        ``reject_future_rv`` — correct, but a needless relist)."""
+        """Where a single-cluster watch stream lands: round-robin
+        across the shard's primary AND its replicas, so live watch
+        connection count scales with the replica count — a replica's
+        stream is its own honest RV sequence. Resumes used to pin to
+        the primary (a lagging replica answered 410 beyond its applied
+        RV); with the consistent-read gate a replica parks the resume
+        until its applied RV covers it, so RV-resumes spread too —
+        reject_future_rv still answers the typed 410 if the bounded
+        wait expires."""
         pools = self._rpools[idx]
-        if not pools or req.param("resourceVersion"):
+        if not pools:
             return None
         j = self._rr[idx] % (len(pools) + 1)
         self._rr[idx] = (j + 1) % (len(pools) + 1)
@@ -571,7 +591,10 @@ class RouterHandler:
         h = {}
         for k, out in (("authorization", "Authorization"),
                        ("content-type", "Content-Type"),
-                       ("accept", "Accept")):
+                       ("accept", "Accept"),
+                       # session read-your-writes floor: the replica's
+                       # RV barrier needs the client's required RV
+                       ("x-kcp-min-rv", "X-Kcp-Min-Rv")):
             v = req.headers.get(k)
             if v:
                 h[out] = v
@@ -595,6 +618,10 @@ class RouterHandler:
             # a routed-but-smart-aware client sees the same staleness
             # signal it would on the direct path
             resp.headers["X-Kcp-Ring-Epoch"] = lower["x-kcp-ring-epoch"]
+        if "x-kcp-rv" in lower:
+            # a write's committed RV: routed clients raise their session
+            # read-your-writes floor from it exactly like direct ones
+            resp.headers["X-Kcp-Rv"] = lower["x-kcp-rv"]
         return resp
 
     @staticmethod
@@ -775,8 +802,11 @@ class RouterHandler:
                         idx, target, req,
                         pool=self._replica_watch_pool(idx, req))
                 if (req.method == "GET" and self._rpools[idx]
-                        and shape is not None
-                        and not req.param("resourceVersion")):
+                        and shape is not None):
+                    # RV-carrying reads (min-RV stamps, RV-pinned
+                    # continue tokens, resourceVersion params) go to
+                    # replicas too: the replica's RV barrier holds the
+                    # read until its applied RV covers the pin
                     return await self._read_via_replica(idx, target, req)
                 status, h, body = await self._call(
                     idx, req.method, target, req.body or None,

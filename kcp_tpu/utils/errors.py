@@ -92,6 +92,19 @@ class UnavailableError(ApiError):
     reason = "ServiceUnavailable"
 
 
+class FrontierTimeoutError(UnavailableError):
+    """A consistent (RV-barrier) read timed out waiting for the replica
+    to apply the required RV (KEP-2340 analog). 504 rather than 503: the
+    replica is healthy but behind — the read itself, not the server, hit
+    its freshness deadline. Subclasses :class:`UnavailableError` so
+    generic 5xx handling (router fallback, smart-client re-route, writer
+    backoff) keeps working; the router matches this type/status to fall
+    back to the primary and meter the reason."""
+
+    code = 504
+    reason = "FrontierWaitTimeout"
+
+
 class RetryableError(Exception):
     """Marker wrapper: retry the operation without a bounded retry budget.
 

@@ -313,6 +313,36 @@ print("replica smoke ok: %sx read capacity @2 | lag p99 %sms | kill: %d acked"
          kill["promote_ms"], kill["epoch"]))
 '
 
+echo "== consistent: RV-barrier consistent-read smoke (read-your-writes, replica-local share, capacity A/B)"
+# 1 primary + lagged replicas (repl.ship delay active): every session
+# read-your-write through the router must come back fresh (zero stale —
+# the barrier parks the read until the replica applies the session
+# floor), >=80% of those consistent reads must be served replica-local
+# (parked, not fallen back to the primary), and consistent-read
+# capacity at 2 replicas must hold >=1.5x the primary-only pin at
+# matched freshness (each endpoint in its own time slice; near-linear
+# is ~3x). Bytes stay sha256-identical to the primary at the same RV.
+cons_line=$(KCP_BENCH_CONS_OBJECTS=500 KCP_BENCH_CONS_SECONDS=0.8 \
+    KCP_BENCH_CONS_LAG_WRITES=60 KCP_BENCH_CONS_RYWR_STEPS=60 \
+    python bench.py --consistent | tail -1)
+printf '%s\n' "$cons_line" | python -c '
+import json, sys
+r = json.loads(sys.stdin.readline())
+cb = r["consistent_bench"]
+assert cb["bytes_equal"], "consistent replica bytes diverged at same RV"
+rw = cb["read_your_writes"]
+assert rw["stale"] == 0, "stale read-your-writes: %s" % rw
+share = rw["replica_local_share"]
+assert share >= 0.8, "replica-local share %s < 0.8 floor" % share
+assert r["value"] >= 1.5, (
+    "consistent read capacity %sx < 1.5x floor at 2 replicas" % r["value"])
+w = cb["wait_for_frontier"]
+print("consistent smoke ok: %sx capacity @2 | rywr %d/%d fresh,"
+      " %.0f%% replica-local | frontier wait p50 %sms p99 %sms"
+      % (r["value"], rw["reads"] - rw["stale"], rw["reads"],
+         share * 100, w["p50_ms"], w["p99_ms"]))
+'
+
 echo "== writes: group-commit A/B smoke (write-path speedup floor, state equality, kill-mid-window drill)"
 # serial vs grouped under KCP_WAL_SYNC=fsync: the write-path component
 # (store commit + WAL sync, the thing the commit window batches) must

@@ -1,0 +1,368 @@
+"""Consistent reads from replicas (KEP-2340 analog).
+
+Covers the PR 20 surface: progress-notify heartbeats keeping the
+follower's frontier fresh on an idle feed, RV-barrier reads parking
+until the replica applies the required RV (then serving byte-identical
+to the primary through the encode-once path), the bounded wait's typed
+504 timeout, lag-shed 503s carrying a computed Retry-After, the
+router's per-reason fallback split, and the differential fuzz the
+ISSUE gates on: session read-your-writes through the router against a
+lagging replica — zero stale reads, byte-identical state, timeouts
+falling back to the primary with no surfaced error.
+"""
+
+import random
+import time
+
+import pytest
+
+from kcp_tpu import faults
+from kcp_tpu.server.rest import RestClient
+from kcp_tpu.server.server import Config
+from kcp_tpu.server.threaded import ServerThread
+from kcp_tpu.utils.errors import (
+    NotFoundError, UnavailableError, retry_after_hint)
+from kcp_tpu.utils.trace import REGISTRY
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    yield
+    faults.clear()
+
+
+def _cm(name: str, cluster: str, data: str = "") -> dict:
+    return {"apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": name, "namespace": "default",
+                         "clusterName": cluster},
+            "data": {"v": data}}
+
+
+def _server(role: str = "shard", primary: str = "", **kw) -> ServerThread:
+    cfg = dict(durable=False, install_controllers=False, tls=False,
+               role=role)
+    if primary:
+        cfg["primary"] = primary
+    cfg.update(kw)
+    return ServerThread(Config(**cfg)).start()
+
+
+def _status(address: str) -> dict:
+    c = RestClient(address)
+    try:
+        return c._request("GET", "/replication/status")
+    finally:
+        c.close()
+
+
+def _wait_applied(address: str, rv: int, timeout: float = 15.0) -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            if int(_status(address)["applied_rv"]) >= rv:
+                return
+        except Exception:
+            pass
+        time.sleep(0.05)
+    raise AssertionError(f"{address} never applied rv {rv}")
+
+
+def _raw_get(address: str, target: str,
+             headers: dict | None = None) -> tuple[int, bytes]:
+    c = RestClient(address)
+    try:
+        status, _h, body = c.request_raw("GET", target, headers=headers)
+        return status, body
+    finally:
+        c.close()
+
+
+# ---------------------------------------------------------------------------
+# progress notify: the frontier stays fresh on an idle feed
+# ---------------------------------------------------------------------------
+
+
+def test_progress_notify_keeps_frontier_fresh_on_idle_feed(monkeypatch):
+    monkeypatch.setenv("KCP_PROGRESS_NOTIFY_MS", "50")
+    p = _server()
+    r = _server(role="replica", primary=p.address)
+    try:
+        pc = RestClient(p.address, cluster="t1")
+        for i in range(3):
+            pc.create("configmaps", _cm(f"cm{i}", "t1", str(i)))
+        _wait_applied(r.address, 3)
+        records = p.call(lambda: len(p.server.repl_hub._records))
+        before = REGISTRY.counter("repl_progress_notify_total").value
+        time.sleep(0.4)  # idle feed: only heartbeats flow
+        assert REGISTRY.counter(
+            "repl_progress_notify_total").value >= before + 2
+        # heartbeats never enter the record window (RV-resume honesty)
+        assert p.call(lambda: len(p.server.repl_hub._records)) == records
+        st = _status(r.address)
+        assert st["applied_rv"] == 3 and st["frontier_rv"] == 3
+        assert "apply_rate" in st
+        pc.close()
+    finally:
+        r.stop()
+        p.stop()
+
+
+def test_consistent_header_serves_frontier_byte_identical(monkeypatch):
+    """``X-Kcp-Min-Rv: consistent`` resolves against the progress-notify
+    frontier and serves through the encode-once path — the replica's
+    bytes are the primary's bytes at that RV."""
+    monkeypatch.setenv("KCP_PROGRESS_NOTIFY_MS", "50")
+    p = _server()
+    r = _server(role="replica", primary=p.address)
+    try:
+        pc = RestClient(p.address, cluster="t1")
+        for i in range(8):
+            pc.create("configmaps", _cm(f"cm{i}", "t1", str(i)))
+        _wait_applied(r.address, 8)
+        t = "/clusters/t1/api/v1/namespaces/default/configmaps"
+        ps, pb = _raw_get(p.address, t)
+        rs, rb = _raw_get(r.address, t,
+                          headers={"X-Kcp-Min-Rv": "consistent"})
+        assert (ps, pb) == (rs, rb)
+        pc.close()
+    finally:
+        r.stop()
+        p.stop()
+
+
+# ---------------------------------------------------------------------------
+# RV barrier: park-then-serve, bounded timeout
+# ---------------------------------------------------------------------------
+
+
+def test_rv_barrier_read_parks_until_applied():
+    """A read pinned to an RV the replica has not applied yet parks on
+    the barrier and serves fresh once the (delayed) ship arrives —
+    byte-identical to the primary, no 404, no staleness."""
+    p = _server()
+    r = _server(role="replica", primary=p.address)
+    try:
+        pc = RestClient(p.address, cluster="t1")
+        pc.create("configmaps", _cm("warm", "t1"))
+        _wait_applied(r.address, 1)
+        faults.install(faults.FaultInjector("repl.ship:latency=250ms"))
+        obj = pc.create("configmaps", _cm("parked", "t1", "fresh"))
+        rv = int(obj["metadata"]["resourceVersion"])
+        before = REGISTRY.counter("consistent_read_waits_total").value
+        t = "/clusters/t1/api/v1/namespaces/default/configmaps/parked"
+        rs, rb = _raw_get(r.address, t,
+                          headers={"X-Kcp-Min-Rv": str(rv)})
+        ps, pb = _raw_get(p.address, t)
+        assert rs == 200 and (rs, rb) == (ps, pb)
+        assert REGISTRY.counter(
+            "consistent_read_waits_total").value > before
+        pc.close()
+    finally:
+        r.stop()
+        p.stop()
+
+
+def test_rv_barrier_timeout_answers_typed_504(monkeypatch):
+    """A required RV beyond anything the feed will deliver inside the
+    bounded wait answers the typed 504 (FrontierWaitTimeout) — the
+    caller's cue to read the primary."""
+    monkeypatch.setenv("KCP_CONSISTENT_READ_TIMEOUT_MS", "200")
+    p = _server()
+    r = _server(role="replica", primary=p.address)
+    try:
+        pc = RestClient(p.address, cluster="t1")
+        pc.create("configmaps", _cm("cm0", "t1"))
+        _wait_applied(r.address, 1)
+        before = REGISTRY.counter("consistent_read_timeouts_total").value
+        t0 = time.perf_counter()
+        rs, rb = _raw_get(
+            r.address, "/clusters/t1/api/v1/namespaces/default/configmaps",
+            headers={"X-Kcp-Min-Rv": "999"})
+        waited = time.perf_counter() - t0
+        assert rs == 504 and b"FrontierWaitTimeout" in rb
+        assert 0.15 <= waited < 5.0  # bounded, not hung
+        assert REGISTRY.counter(
+            "consistent_read_timeouts_total").value > before
+        # the primary is never gated: the same pin reads past it fine
+        # (it IS the frontier; a future RV there means a caller bug and
+        # the plain list answers at the current RV)
+        pc.close()
+    finally:
+        r.stop()
+        p.stop()
+
+
+def test_dead_feed_fast_fails_barrier_reads(monkeypatch):
+    """Failover realism: when the primary dies, the follower's feed is
+    down and its frontier frozen — a pinned read above the frontier can
+    NEVER be satisfied by an in-flight record, so the barrier must not
+    park the full window (that would turn every consistent read into a
+    full timeout mid failover, starving watchers and relists behind the
+    router). The typed 504 must come back near-instantly."""
+    monkeypatch.setenv("KCP_CONSISTENT_READ_TIMEOUT_MS", "5000")
+    p = _server()
+    r = _server(role="replica", primary=p.address)
+    try:
+        pc = RestClient(p.address, cluster="t1")
+        pc.create("configmaps", _cm("cm0", "t1"))
+        _wait_applied(r.address, 1)
+        pc.close()
+        p.stop()
+        deadline = time.time() + 10.0
+        while r.call(lambda: r.server.repl_applier.connected):
+            assert time.time() < deadline, "feed never noticed the death"
+            time.sleep(0.05)
+        t0 = time.perf_counter()
+        rs, rb = _raw_get(
+            r.address, "/clusters/t1/api/v1/namespaces/default/configmaps",
+            headers={"X-Kcp-Min-Rv": "999"})
+        waited = time.perf_counter() - t0
+        assert rs == 504 and b"FrontierWaitTimeout" in rb
+        assert waited < 1.0  # fast-fail, nowhere near the 5s window
+        # a pin at or below the applied RV still serves locally: the
+        # dead feed only blocks reads the follower has never seen
+        rs2, _ = _raw_get(
+            r.address, "/clusters/t1/api/v1/namespaces/default/configmaps",
+            headers={"X-Kcp-Min-Rv": "1"})
+        assert rs2 == 200
+    finally:
+        r.stop()
+        p.stop()
+
+
+# ---------------------------------------------------------------------------
+# lag shed: computed Retry-After
+# ---------------------------------------------------------------------------
+
+
+def test_lag_shed_503_carries_computed_retry_after():
+    """KCP_REPL_LAG_MAX refusals pace the client honestly: Retry-After
+    is the current lag divided by the recent apply rate (capped), not a
+    generic constant."""
+    p = _server()
+    r = _server(role="replica", primary=p.address, repl_lag_max=3)
+    try:
+        pc = RestClient(p.address, cluster="t1")
+        for i in range(3):
+            pc.create("configmaps", _cm(f"cm{i}", "t1"))
+        _wait_applied(r.address, 3)
+
+        def fake_lag():
+            ap = r.server.repl_applier
+            ap.last_seen_rv = ap.store.resource_version + 10
+            ap._apply_rate = 2.0
+        r.call(fake_lag)
+        rc = RestClient(r.address, cluster="t1")
+        with pytest.raises(UnavailableError) as ei:
+            rc.list("configmaps", namespace="default")
+        # 10 records behind at 2 records/s -> 5s pacing hint
+        assert retry_after_hint(ei.value) == 5.0
+        rc.close()
+        pc.close()
+    finally:
+        r.stop()
+        p.stop()
+
+
+# ---------------------------------------------------------------------------
+# router: per-reason fallback split + read-your-writes fuzz
+# ---------------------------------------------------------------------------
+
+
+def _trio(tmp_path):
+    primary = _server(durable=True, root_dir=str(tmp_path / "p"))
+    replica = _server(role="replica", primary=primary.address)
+    router = ServerThread(Config(
+        role="router", durable=False, tls=False,
+        shards=f"s0={primary.address}|{replica.address}")).start()
+    return primary, replica, router
+
+
+def test_router_falls_back_on_barrier_timeout_no_surfaced_error(
+        tmp_path, monkeypatch):
+    """A consistent read whose replica barrier times out falls back to
+    the primary inside the router: the client sees fresh data and no
+    error; the fallback is metered under its reason."""
+    monkeypatch.setenv("KCP_CONSISTENT_READ_TIMEOUT_MS", "100")
+    primary, replica, router = _trio(tmp_path)
+    try:
+        pc = RestClient(router.address, cluster="t1")
+        pc.create("configmaps", _cm("warm", "t1"))
+        _wait_applied(replica.address, 1)
+        # the feed dies: the replica can never cover new session floors
+        faults.install(faults.FaultInjector("repl.ship:error=1.0"))
+        before = REGISTRY.counter(
+            "router_replica_fallback_consistent_timeout_total").value
+        pc.create("configmaps", _cm("after-cut", "t1", "fresh"))
+        got = pc.get("configmaps", "after-cut", "default")
+        assert got["data"]["v"] == "fresh"  # primary answered, fresh
+        assert REGISTRY.counter(
+            "router_replica_fallback_consistent_timeout_total"
+        ).value > before
+        pc.close()
+    finally:
+        router.stop()
+        replica.stop()
+        primary.stop()
+
+
+def test_differential_fuzz_read_your_writes_through_router(tmp_path):
+    """The ISSUE's differential gauntlet: seeded CRUD through the
+    router while ``repl.ship`` latency keeps the replica behind, with
+    the session client reading its own writes back immediately. Every
+    read-your-write is fresh (zero stale responses, deletes observed),
+    a meaningful share is served replica-local (the barrier parks
+    instead of falling back), and the converged state is byte-identical
+    between primary and replica."""
+    primary, replica, router = _trio(tmp_path)
+    try:
+        faults.install(faults.FaultInjector("repl.ship:latency=30ms",
+                                            seed=20260807))
+        pc = RestClient(router.address, cluster="t1")
+        reads_before = REGISTRY.counter("router_replica_reads_total").value
+        rng = random.Random(20260807)
+        live: dict[str, str] = {}
+        stale: list[str] = []
+        for step in range(50):
+            roll = rng.random()
+            if live and roll < 0.2:
+                name = rng.choice(sorted(live))
+                pc.delete("configmaps", name, "default")
+                del live[name]
+                with pytest.raises(NotFoundError):
+                    pc.get("configmaps", name, "default")
+                continue
+            if live and roll < 0.5:
+                name = rng.choice(sorted(live))
+                got = pc.get("configmaps", name, "default")
+                got["data"] = {"v": f"u{step}"}
+                pc.update("configmaps", got)
+                live[name] = f"u{step}"
+            else:
+                name = f"f{step}"
+                pc.create("configmaps", _cm(name, "t1", str(step)))
+                live[name] = str(step)
+            got = pc.get("configmaps", name, "default")
+            if got["data"]["v"] != live[name]:
+                stale.append(f"{name}: {got['data']['v']} != {live[name]}")
+        assert not stale, f"stale read-your-writes: {stale}"
+        # the barrier parked instead of burning the primary: replica
+        # served a meaningful share of the session's consistent reads
+        replica_reads = (REGISTRY.counter(
+            "router_replica_reads_total").value - reads_before)
+        assert replica_reads > 0
+        assert REGISTRY.counter("consistent_read_waits_total").value > 0
+
+        faults.clear()
+        rv = int(_status(primary.address)["applied_rv"])
+        _wait_applied(replica.address, rv)
+        t = "/clusters/t1/api/v1/namespaces/default/configmaps"
+        ps, pb = _raw_get(primary.address, t)
+        rs, rb = _raw_get(replica.address, t,
+                          headers={"X-Kcp-Min-Rv": str(rv)})
+        assert (ps, pb) == (rs, rb)
+        pc.close()
+    finally:
+        router.stop()
+        replica.stop()
+        primary.stop()

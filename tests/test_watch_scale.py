@@ -504,10 +504,12 @@ def test_router_spreads_fresh_watches_across_replicas(tmp_path):
         primary.stop()
 
 
-def test_resume_through_router_stays_on_primary(tmp_path):
-    """A watch resume (?resourceVersion=) is pinned to the primary: the
-    spread counter must not move and the resumed stream replays from
-    the primary's window."""
+def test_resume_through_router_spreads_to_replica(tmp_path):
+    """A watch resume (?resourceVersion=) is no longer pinned to the
+    primary: the replica's RV barrier parks the resume until its applied
+    RV covers the pin, so resumes round-robin across primary+replicas
+    like fresh watches. Two consecutive resumes land one on each, and
+    both replay the identical window."""
     from kcp_tpu.server.server import Config
     from kcp_tpu.server.threaded import ServerThread
 
@@ -525,9 +527,8 @@ def test_resume_through_router_stays_on_primary(tmp_path):
         for i in range(5):
             pc.create("configmaps", _cm(f"r{i}", "t0"))
         before = REGISTRY.counter("router_watch_spread_total").value
-        w = pc.watch("configmaps", "default", since_rv=2)
 
-        async def collect() -> list:
+        async def collect(w) -> list:
             out = []
             async for ev in w:
                 out.append(ev.name)
@@ -535,11 +536,14 @@ def test_resume_through_router_stays_on_primary(tmp_path):
                     break
             return out
 
-        names = asyncio.run(collect())
-        assert names == ["r2", "r3", "r4"]
+        # round-robin over [replica, primary]: exactly one of the two
+        # resumes is spread, and both must replay the same window
+        for _ in range(2):
+            w = pc.watch("configmaps", "default", since_rv=2)
+            assert asyncio.run(collect(w)) == ["r2", "r3", "r4"]
+            w.close()
         assert REGISTRY.counter(
-            "router_watch_spread_total").value == before
-        w.close()
+            "router_watch_spread_total").value == before + 1
         pc.close()
     finally:
         router.stop()
